@@ -1,0 +1,28 @@
+"""HeteroFL-TPU: a TPU-native federated-learning framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+``diaoenmao/HeteroFL-Computation-and-Communication-Efficient-Federated-Learning-
+for-Heterogeneous-Clients`` (ICLR 2021): federated training of *width-nested*
+heterogeneous client sub-models with counted averaging, static batch norm and
+activation scaling.
+
+Design stance (vs. the PyTorch reference at ``/root/reference``):
+
+* The reference slices a global model into per-client sub-``state_dict``\\ s in
+  Python loops (``src/fed.py:26-178``) and trains clients sequentially.  Here a
+  full communication round is **one XLA program**: clients live on a
+  ``clients`` mesh axis, local SGD runs under ``vmap``/``shard_map``, and
+  aggregation is a masked ``psum`` over ICI.
+* Width heterogeneity is expressed with **channel masks over full-width
+  tensors** instead of shape-changing slices.  HeteroFL sub-models are always
+  *prefix* slices (``src/fed.py:46-48``), so masking the suffix to zero is
+  mathematically identical to slicing (proved in ``tests/test_equivalence.py``)
+  while keeping every client step the same static shape -- no per-width
+  recompiles, runtime (data-dependent) rate assignment, and full MXU tiles.
+* A "sliced" execution strategy (true small shapes, one compiled program per
+  rate level) is also provided for host-side debugging and parity checks.
+"""
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401

@@ -1,0 +1,258 @@
+"""Configuration core: defaults, control-string codec, derived hyperparameters.
+
+Parity target: the reference's global ``cfg`` dict (``src/config.py:3-6``,
+``src/config.yml``) and ``process_control()`` (``src/utils.py:113-215``).
+Unlike the reference this module is purely functional -- no import-time global
+mutable state; entry points build a cfg dict and pass it explicitly.
+
+The 9-field control string
+``fed_numusers_frac_datasplit_modelsplit_modelmode_norm_scale_mask``
+(e.g. ``1_100_0.1_iid_fix_a2-b8_bn_1_1``) doubles as the experiment tag
+(``src/train_classifier_fed.py:30,41-42``).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+from typing import Any, Dict, List
+
+import numpy as np
+
+# Width multiplier per complexity level (ref src/utils.py:114).
+MODEL_SPLIT_RATE: Dict[str, float] = {"a": 1.0, "b": 0.5, "c": 0.25, "d": 0.125, "e": 0.0625}
+
+CONTROL_KEYS = (
+    "fed",
+    "num_users",
+    "frac",
+    "data_split_mode",
+    "model_split_mode",
+    "model_mode",
+    "norm",
+    "scale",
+    "mask",
+)
+
+# Defaults mirroring the reference's config.yml (src/config.yml:1-55), minus
+# torch-isms. ``device`` keeps its role as an execution hint ("tpu"/"cpu").
+DEFAULT_CFG: Dict[str, Any] = {
+    "control": {
+        "fed": "1",
+        "num_users": "100",
+        "frac": "0.1",
+        "data_split_mode": "iid",
+        "model_split_mode": "fix",
+        "model_mode": "a1",
+        "norm": "bn",
+        "scale": "1",
+        "mask": "1",
+    },
+    "data_name": "CIFAR10",
+    "subset": "label",
+    "batch_size": {"train": 128, "test": 128},
+    "shuffle": {"train": True, "test": False},
+    "num_workers": 0,
+    "model_name": "resnet18",
+    "metric_name": {"train": ["Loss", "Accuracy"], "test": ["Loss", "Accuracy"]},
+    "optimizer_name": "Adam",
+    "lr": 3.0e-4,
+    "momentum": 0.9,
+    "weight_decay": 5.0e-4,
+    "scheduler_name": "None",
+    "step_size": 1,
+    "milestones": [100, 150],
+    "patience": 10,
+    "threshold": 1.0e-3,
+    "factor": 0.5,
+    "min_lr": 1.0e-4,
+    "init_seed": 0,
+    "num_experiments": 1,
+    "num_epochs": 200,
+    "log_interval": 0.25,
+    "device": "tpu",
+    "world_size": 1,
+    "resume_mode": 0,
+    "save_format": "pdf",
+    # TPU-native extras (no reference counterpart):
+    "strategy": "masked",  # "masked" (one program, channel masks) | "sliced"
+    "param_dtype": "float32",
+    "compute_dtype": "float32",  # set "bfloat16" to run matmuls/convs in bf16
+    "mesh": {"clients": 0, "data": 1},  # 0 => use all available devices
+    "data_dir": "./data",
+    "output_dir": "./output",
+    "synthetic": False,  # force synthetic data (offline/testing)
+}
+
+
+def default_cfg() -> Dict[str, Any]:
+    return copy.deepcopy(DEFAULT_CFG)
+
+
+def parse_control_name(control_name: str) -> Dict[str, str]:
+    """Split an underscore-separated control string into the 9 control fields.
+
+    Mirrors ``src/train_classifier_fed.py:27-29``.
+    """
+    if control_name in (None, "None", ""):
+        return {}
+    parts = control_name.split("_")
+    if len(parts) != len(CONTROL_KEYS):
+        raise ValueError(
+            f"control string must have {len(CONTROL_KEYS)} fields "
+            f"{CONTROL_KEYS}, got {len(parts)}: {control_name!r}"
+        )
+    return dict(zip(CONTROL_KEYS, parts))
+
+
+def control_name_of(control: Dict[str, str]) -> str:
+    """Inverse of :func:`parse_control_name` (ref train_classifier_fed.py:30).
+
+    Joins in canonical ``CONTROL_KEYS`` order (not dict insertion order) so a
+    reordered dict still produces the canonical tag."""
+    return "_".join(control[k] for k in CONTROL_KEYS)
+
+
+def make_model_tag(seed: int, cfg: Dict[str, Any]) -> str:
+    """Experiment tag keying checkpoints/results (ref train_classifier_fed.py:41-42)."""
+    parts = [str(seed), cfg["data_name"], cfg.get("subset", ""), cfg["model_name"], cfg["control_name"]]
+    return "_".join(x for x in parts if x)
+
+
+def _fix_rate_vector(mode_rate: List[float], proportion: List[int], num_users: int) -> List[float]:
+    """Static per-user rate assignment for ``fix`` mode.
+
+    Exact parity with src/utils.py:134-144: each level gets
+    ``num_users // sum(proportion) * proportion_i`` users in level order, and
+    any remainder is filled with the *last* (smallest) level's rate.
+    """
+    num_users_proportion = num_users // sum(proportion)
+    model_rate: List[float] = []
+    for i in range(len(mode_rate)):
+        model_rate += list(np.repeat(mode_rate[i], num_users_proportion * proportion[i]))
+    model_rate = model_rate + [model_rate[-1] for _ in range(num_users - len(model_rate))]
+    return [float(r) for r in model_rate]
+
+
+def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """Expand ``cfg['control']`` into every derived hyperparameter.
+
+    Parity with ``src/utils.py:113-215``. Returns a new cfg dict (input is not
+    mutated). Raises ``ValueError`` on invalid modes, like the reference.
+    """
+    cfg = copy.deepcopy(cfg)
+    ctl = cfg["control"]
+    cfg["control_name"] = control_name_of(ctl)
+    cfg["model_split_rate"] = dict(MODEL_SPLIT_RATE)
+    cfg["fed"] = int(ctl["fed"])
+    cfg["num_users"] = int(ctl["num_users"])
+    cfg["frac"] = float(ctl["frac"])
+    cfg["data_split_mode"] = ctl["data_split_mode"]
+    cfg["model_split_mode"] = ctl["model_split_mode"]
+    cfg["model_mode"] = ctl["model_mode"]
+    cfg["norm"] = ctl["norm"]
+    cfg["scale"] = bool(int(ctl["scale"]))
+    cfg["mask"] = bool(int(ctl["mask"]))
+    cfg["global_model_mode"] = cfg["model_mode"][0]
+    cfg["global_model_rate"] = cfg["model_split_rate"][cfg["global_model_mode"]]
+    model_mode = cfg["model_mode"].split("-")
+    mode_rate, proportion = [], []
+    for m in model_mode:
+        mode_rate.append(cfg["model_split_rate"][m[0]])
+        proportion.append(int(m[1:]))
+    if cfg["model_split_mode"] == "dynamic":
+        cfg["model_rate"] = mode_rate
+        cfg["proportion"] = (np.array(proportion) / sum(proportion)).tolist()
+    elif cfg["model_split_mode"] == "fix":
+        cfg["model_rate"] = _fix_rate_vector(mode_rate, proportion, cfg["num_users"])
+    else:
+        raise ValueError("Not valid model split mode")
+    # Architecture tables (ref src/utils.py:147-149).
+    cfg["conv"] = {"hidden_size": [64, 128, 256, 512]}
+    cfg["resnet"] = {"hidden_size": [64, 128, 256, 512]}
+    cfg["transformer"] = {
+        "embedding_size": 256,
+        "num_heads": 8,
+        "hidden_size": 512,
+        "num_layers": 4,
+        "dropout": 0.2,
+    }
+    # Per-dataset hyperparameters (ref src/utils.py:150-212).
+    data_name = cfg["data_name"]
+    split = cfg["data_split_mode"]
+    if data_name in ("MNIST", "FashionMNIST"):
+        cfg["data_shape"] = [28, 28, 1]  # NHWC (reference is CHW [1,28,28])
+        cfg["optimizer_name"] = "SGD"
+        cfg["lr"] = 1e-2
+        cfg["momentum"] = 0.9
+        cfg["weight_decay"] = 5e-4
+        cfg["scheduler_name"] = "MultiStepLR"
+        cfg["factor"] = 0.1
+        if split == "iid":
+            cfg["num_epochs"] = {"global": 200, "local": 5}
+            cfg["batch_size"] = {"train": 10, "test": 50}
+            cfg["milestones"] = [100]
+        elif "non-iid" in split:
+            cfg["num_epochs"] = {"global": 400, "local": 5}
+            cfg["batch_size"] = {"train": 10, "test": 50}
+            cfg["milestones"] = [200]
+        elif split == "none":
+            cfg["num_epochs"] = 200
+            cfg["batch_size"] = {"train": 100, "test": 500}
+            cfg["milestones"] = [100]
+        else:
+            raise ValueError("Not valid data_split_mode")
+    elif data_name in ("CIFAR10", "CIFAR100"):
+        cfg["data_shape"] = [32, 32, 3]
+        cfg["optimizer_name"] = "SGD"
+        cfg["lr"] = 1e-1
+        cfg["momentum"] = 0.9
+        cfg["weight_decay"] = 5e-4
+        cfg["scheduler_name"] = "MultiStepLR"
+        cfg["factor"] = 0.1
+        if split == "iid":
+            cfg["num_epochs"] = {"global": 400, "local": 5}
+            cfg["batch_size"] = {"train": 10, "test": 50}
+            cfg["milestones"] = [150, 250]
+        elif "non-iid" in split:
+            cfg["num_epochs"] = {"global": 800, "local": 5}
+            cfg["batch_size"] = {"train": 10, "test": 50}
+            cfg["milestones"] = [300, 500]
+        elif split == "none":
+            cfg["num_epochs"] = 400
+            cfg["batch_size"] = {"train": 100, "test": 500}
+            cfg["milestones"] = [150, 250]
+        else:
+            raise ValueError("Not valid data_split_mode")
+    elif data_name in ("PennTreebank", "WikiText2", "WikiText103"):
+        cfg["optimizer_name"] = "SGD"
+        cfg["lr"] = 1e-1
+        cfg["momentum"] = 0.9
+        cfg["weight_decay"] = 5e-4
+        cfg["scheduler_name"] = "MultiStepLR"
+        cfg["factor"] = 0.1
+        cfg["bptt"] = 64
+        cfg["mask_rate"] = 0.15
+        if split == "iid":
+            cfg["num_epochs"] = {"global": 200, "local": 1}
+            cfg["batch_size"] = {"train": 100, "test": 10}
+            cfg["milestones"] = [50, 100]
+        elif split == "none":
+            cfg["num_epochs"] = 100
+            cfg["batch_size"] = {"train": 100, "test": 100}
+            cfg["milestones"] = [25, 50]
+        else:
+            raise ValueError("Not valid data_split_mode")
+    else:
+        raise ValueError("Not valid dataset")
+    return cfg
+
+
+def ceil_width(size: int, rate: float) -> int:
+    """Active width of a sliced dimension: ``ceil(size * rate)`` (ref fed.py:47)."""
+    return int(math.ceil(size * rate))
+
+
+def scaled_hidden(hidden_size: List[int], model_rate: float) -> List[int]:
+    """Per-layer widths of a sub-model (ref models/conv.py:77)."""
+    return [ceil_width(x, model_rate) for x in hidden_size]

@@ -1,0 +1,18 @@
+"""Host-side data layer: ingestion, partitioning, device-feed preparation.
+
+Parity targets: ``src/data.py``, ``src/datasets/*`` in the reference. All
+arrays are NumPy (NHWC for images); device-side augmentation/normalisation
+lives in :mod:`heterofl_tpu.ops.augment` so it fuses into the jitted step.
+"""
+
+from .datasets import ArrayDataset, TokenDataset, fetch_dataset, DATASET_STATS  # noqa: F401
+from .partition import iid, non_iid, split_dataset  # noqa: F401
+from .pipeline import (  # noqa: F401
+    process_dataset,
+    batchify,
+    bptt_windows,
+    stack_client_shards,
+    stack_client_token_rows,
+    label_split_masks,
+)
+from .vocab import Vocab  # noqa: F401

@@ -1,0 +1,101 @@
+"""Device-feed preparation: token batchify, bptt windowing, and stacking of
+per-client shards into dense ``[num_clients, ...]`` arrays for the jitted
+federated round.
+
+The reference streams per-client Python ``DataLoader``\\ s sequentially
+(``src/train_classifier_fed.py:177-180``); here all active clients' shards are
+materialised as one stacked array so local training vectorises with ``vmap``
+and shards over the ``clients`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def process_dataset(cfg: Dict, dataset: Dict) -> Tuple[Dict, Dict]:
+    """Derive data-dependent cfg fields and batchify LM streams
+    (ref src/utils.py:100-110). Returns (new_cfg, new_dataset)."""
+    import copy
+
+    cfg = copy.deepcopy(cfg)
+    dataset = dict(dataset)
+    if hasattr(dataset["train"], "classes_size"):
+        cfg["classes_size"] = dataset["train"].classes_size
+    else:
+        cfg["vocab"] = dataset["train"].vocab
+        cfg["num_tokens"] = len(dataset["train"].vocab)
+        cfg["classes_size"] = cfg["num_tokens"]
+        for split in dataset:
+            ds = dataset[split]
+            bs = cfg["batch_size"][split]
+            dataset[split] = type(ds)(batchify(ds.token, bs), ds.vocab, ds.data_name)
+    return cfg, dataset
+
+
+def batchify(token: np.ndarray, batch_size: int) -> np.ndarray:
+    """Reshape a 1-D token stream to ``[batch_size, -1]`` (ref utils.py:353-357)."""
+    num_batch = len(token) // batch_size
+    return token[: num_batch * batch_size].reshape(batch_size, -1)
+
+
+def bptt_windows(rows: np.ndarray, bptt: int) -> List[np.ndarray]:
+    """Split ``[R, T]`` rows into windows of ``bptt`` along T (ref data.py:136-150).
+
+    The final window may be shorter, matching ``BatchDataset``.
+    """
+    return [rows[:, s: s + bptt] for s in range(0, rows.shape[1], bptt)]
+
+
+def stack_client_shards(data: np.ndarray, target: np.ndarray,
+                        data_split: Dict[int, List[int]], user_idx: List[int]
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Gather the selected users' shards into dense arrays.
+
+    Returns ``(x, y, sample_mask)`` with shapes ``[C, N, ...]``, ``[C, N]``,
+    ``[C, N]`` where ``N`` is the max shard size among the selected users;
+    shorter shards are padded by repeating their first items with
+    ``sample_mask == 0`` so padded examples carry zero loss weight.
+    """
+    sizes = [len(data_split[u]) for u in user_idx]
+    n = max(sizes)
+    xs, ys, ms = [], [], []
+    for u, sz in zip(user_idx, sizes):
+        idx = np.asarray(data_split[u], dtype=np.int64)
+        if sz < n:
+            pad = idx[np.arange(n - sz) % sz]
+            idx = np.concatenate([idx, pad])
+        xs.append(data[idx])
+        ys.append(target[idx])
+        m = np.zeros(n, dtype=np.float32)
+        m[:sz] = 1.0
+        ms.append(m)
+    return np.stack(xs), np.stack(ys), np.stack(ms)
+
+
+def stack_client_token_rows(token_rows: np.ndarray, data_split: Dict[int, List[int]],
+                            user_idx: List[int]) -> np.ndarray:
+    """LM analogue: gather each user's batchified rows -> ``[C, R, T]``.
+
+    After ``batchify`` each "example" is a row of the token matrix; iid
+    splitting assigns whole rows to users (ref data.py:64-65 with
+    ``train_transformer_fed.py:161``).
+    """
+    rows = [token_rows[np.asarray(data_split[u], dtype=np.int64)] for u in user_idx]
+    r = max(x.shape[0] for x in rows)
+    assert all(x.shape[0] == r for x in rows), "per-user row counts must match"
+    return np.stack(rows)
+
+
+def label_split_masks(label_split, num_users: int, classes_size: int) -> np.ndarray:
+    """Dense ``[num_users, classes_size]`` 0/1 masks from per-user label lists.
+
+    Replaces the reference's variable-length ``label_split`` index lists
+    (``src/fed.py:193-198``) with a static-shape mask, as required for XLA.
+    """
+    m = np.zeros((num_users, classes_size), dtype=np.float32)
+    for i in range(num_users):
+        m[i, np.asarray(label_split[i], dtype=np.int64)] = 1.0
+    return m

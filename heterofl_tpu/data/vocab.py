@@ -1,0 +1,37 @@
+"""Symbol/index vocabulary for language-modeling datasets.
+
+Parity: ``src/datasets/lm.py:9-51`` (``<ukn>``=0, ``<eos>``=1, insertion
+order, unknown-symbol fallback).
+"""
+
+from __future__ import annotations
+
+
+class Vocab:
+    def __init__(self):
+        self.symbol_to_index = {"<ukn>": 0, "<eos>": 1}
+        self.index_to_symbol = ["<ukn>", "<eos>"]
+
+    def add(self, symbol: str) -> None:
+        if symbol not in self.symbol_to_index:
+            self.index_to_symbol.append(symbol)
+            self.symbol_to_index[symbol] = len(self.index_to_symbol) - 1
+
+    def __len__(self) -> int:
+        return len(self.index_to_symbol)
+
+    def __getitem__(self, query):
+        if isinstance(query, int):
+            if 0 <= query < len(self.index_to_symbol):
+                return self.index_to_symbol[query]
+            return "<ukn>"
+        if isinstance(query, str):
+            return self.symbol_to_index.get(query, self.symbol_to_index["<ukn>"])
+        raise ValueError("Not valid data type")
+
+    def __contains__(self, query) -> bool:
+        if isinstance(query, int):
+            return 0 <= query < len(self.index_to_symbol)
+        if isinstance(query, str):
+            return query in self.symbol_to_index
+        raise ValueError("Not valid data type")

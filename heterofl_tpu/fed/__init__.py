@@ -1,0 +1,10 @@
+from .core import (  # noqa: F401
+    active_indices,
+    combine_counted,
+    embed_sliced,
+    extract_sliced,
+    sample_model_rates,
+    to_width_rates,
+    client_count_masks,
+    distribute_masked,
+)

@@ -1,0 +1,149 @@
+"""Federation core: sub-model extraction and counted-average aggregation.
+
+This replaces the reference ``Federation`` class (``src/fed.py``) with pure
+functions over param pytrees.  Two execution strategies share one algebra:
+
+* **masked** (default, TPU-native): ``distribute`` multiplies the global
+  params by the client's width mask (suffix -> 0); ``combine`` is
+  ``sum(P_c * M_c) / sum(M_c)`` with the stale-value fallback where no client
+  contributed (ref fed.py:217-218).  Everything is static-shape and jittable;
+  under ``shard_map`` the two sums become ``psum`` over the clients axis.
+* **sliced**: true small tensors via host-side gather (``extract_sliced``) and
+  scatter-back (``embed_sliced``), matching the reference's deepcopy
+  simulation; used for debugging and the equivalence tests.
+
+Label-split restriction of output layers (ref fed.py:193-198,228-233,263-274)
+enters through the ``label_mask`` axis of the count masks -- clients train
+their full output rows but only their label rows are aggregated.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import ModelDef
+from ..models.spec import Group, ParamSpec, count_masks as _count_masks, mask_params
+
+
+def sample_model_rates(key: jax.Array, cfg: Dict[str, Any],
+                       user_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Absolute model rates of the given users for one round.
+
+    ``fix``: the static per-user vector computed by ``process_control`` (ref
+    utils.py:134-144), indexed by the *selected* user ids (ref fed.py
+    ``self.model_rate[user_idx[m]]``).  ``dynamic``: i.i.d. multinomial
+    re-roll over ``cfg['proportion']`` every round (ref fed.py:15-19) -- a
+    traced sample, so dynamic mode stays inside the jitted round.
+
+    NOTE: these are *absolute* rates; convert with :func:`to_width_rates`
+    before driving masks/Scaler (the reference likewise slices by
+    ``model_rate / global_model_rate``, fed.py:46).
+    """
+    if user_idx is None:
+        user_idx = jnp.arange(cfg["num_users"])
+    user_idx = jnp.asarray(user_idx)
+    if cfg["model_split_mode"] == "fix":
+        return jnp.take(jnp.asarray(cfg["model_rate"], jnp.float32), user_idx)
+    if cfg["model_split_mode"] == "dynamic":
+        rates = jnp.asarray(cfg["model_rate"], jnp.float32)
+        idx = jax.random.choice(key, len(rates), shape=user_idx.shape, p=jnp.asarray(cfg["proportion"]))
+        return rates[idx]
+    raise ValueError("Not valid model split mode")
+
+
+def to_width_rates(model_rates: jnp.ndarray, cfg: Dict[str, Any]) -> jnp.ndarray:
+    """Absolute model rate -> width/scaler rate relative to the global model
+    (``scaler_rate = model_rate / global_model_rate``, ref fed.py:46,
+    models/conv.py:79).  Group sizes are already scaled by the global rate, so
+    masks must use this relative rate or non-'a' global modes double-shrink."""
+    return jnp.asarray(model_rates, jnp.float32) / cfg["global_model_rate"]
+
+
+def distribute_masked(global_params: Dict[str, jnp.ndarray], model: ModelDef,
+                      width_rate) -> Dict[str, jnp.ndarray]:
+    """Masked-strategy ``Federation.distribute`` for one client
+    (ref fed.py:161-178): active prefix keeps global values, suffix is zero."""
+    return mask_params(global_params, model.specs, model.groups, width_rate)
+
+
+def client_count_masks(global_params: Dict[str, jnp.ndarray], model: ModelDef,
+                       width_rate, label_mask) -> Dict[str, jnp.ndarray]:
+    """Aggregation contribution masks for one client (width x label split)."""
+    shapes = {k: v.shape for k, v in global_params.items()}
+    return _count_masks(shapes, model.specs, model.groups, width_rate, label_mask)
+
+
+def combine_counted(global_params: Dict[str, jnp.ndarray],
+                    summed: Dict[str, jnp.ndarray],
+                    counts: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Counted average with stale fallback: ``v[count>0] = (sum/count)``,
+    elements no client held keep the previous global value (ref fed.py:217-218)."""
+    out = {}
+    for k, v in global_params.items():
+        c = counts[k]
+        out[k] = jnp.where(c > 0, summed[k] / jnp.maximum(c, 1.0), v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sliced strategy (host-side gather/scatter, reference-shaped sub-models)
+# ---------------------------------------------------------------------------
+
+def active_indices(group: Group, width_rate: float) -> np.ndarray:
+    """Concrete active index set of a group at a given rate (host-side)."""
+    if group.kind == "full":
+        return np.arange(group.size)
+    if group.kind == "prefix":
+        k = int(math.ceil(group.size * width_rate))
+        return np.arange(group.size)[:k]
+    if group.kind == "per_head":
+        hd = group.size // group.num_heads
+        kh = int(math.ceil(hd * width_rate))
+        return (np.arange(group.size).reshape(group.num_heads, hd)[:, :kh]).reshape(-1)
+    raise ValueError(group.kind)
+
+
+def extract_sliced(params: Dict[str, np.ndarray], specs: Dict[str, ParamSpec],
+                   groups: Dict[str, Group], width_rate: float) -> Dict[str, np.ndarray]:
+    """Gather a true sub-model's params from the global params
+    (the reference's ``v[torch.meshgrid(param_idx)]`` deepcopy, fed.py:165-178)."""
+    out = {}
+    for k, v in params.items():
+        v = np.asarray(v)
+        for axis, gname in sorted(specs[k].axis_groups.items()):
+            v = np.take(v, active_indices(groups[gname], width_rate), axis=axis)
+        out[k] = v.copy()
+    return out
+
+
+def embed_sliced(sliced: Dict[str, np.ndarray], specs: Dict[str, ParamSpec],
+                 groups: Dict[str, Group], width_rate: float,
+                 full_shapes: Dict[str, tuple]) -> Dict[str, np.ndarray]:
+    """Scatter a sub-model's params back into zero full-width tensors
+    (inverse of :func:`extract_sliced`; the sliced-strategy half of combine)."""
+    out = {}
+    for k, small in sliced.items():
+        idx_arrays = {axis: active_indices(groups[gname], width_rate)
+                      for axis, gname in specs[k].axis_groups.items()}
+        if not idx_arrays:
+            out[k] = np.asarray(small).copy()
+            continue
+        full = np.zeros(full_shapes[k], dtype=np.asarray(small).dtype)
+        out[k] = _scatter_axes(full, np.asarray(small), idx_arrays)
+    return out
+
+
+def _scatter_axes(full: np.ndarray, small: np.ndarray, idx_arrays: Dict[int, np.ndarray]) -> np.ndarray:
+    """full[axes-product of idx] = small, returning full."""
+    axes = sorted(idx_arrays)
+    perm = axes + [a for a in range(full.ndim) if a not in axes]
+    inv = np.argsort(perm)
+    fullp = np.transpose(full, perm)
+    smallp = np.transpose(small, perm)
+    fullp[np.ix_(*[idx_arrays[a] for a in axes])] = smallp
+    return np.transpose(fullp, inv)

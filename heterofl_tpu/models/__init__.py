@@ -1,0 +1,59 @@
+"""Model factory registry.
+
+Parity with the reference factories (``src/models/conv.py:75-82``,
+``src/models/resnet.py:161-208``, ``src/models/transformer.py:165-175``):
+constructed widths are ``ceil(model_rate * base)``, the Scaler rate is
+``model_rate / global_model_rate``.
+
+``make_model(cfg)`` builds the **global** model; ``make_model(cfg, rate)``
+builds a true sliced sub-model (used by the "sliced" strategy and the
+equivalence tests).  In the default masked strategy only the global model is
+ever constructed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..config import ceil_width, scaled_hidden
+from .base import ModelDef  # noqa: F401
+from .conv import make_conv
+from .resnet import make_resnet
+from .spec import Group, ParamSpec, count_masks, mask_params, param_mask  # noqa: F401
+from .transformer import make_transformer
+
+RESNET_BLOCKS = {
+    "resnet18": ([2, 2, 2, 2], False),
+    "resnet34": ([3, 4, 6, 3], False),
+    "resnet50": ([3, 4, 6, 3], True),
+    "resnet101": ([3, 4, 23, 3], True),
+    "resnet152": ([3, 8, 36, 3], True),
+}
+
+MODEL_NAMES = ("conv",) + tuple(RESNET_BLOCKS) + ("transformer",)
+
+
+def make_model(cfg: Dict[str, Any], model_rate: Optional[float] = None) -> ModelDef:
+    name = cfg["model_name"]
+    if model_rate is None:
+        model_rate = cfg["global_model_rate"]
+    scaler_rate = model_rate / cfg["global_model_rate"]
+    if name == "conv":
+        model = make_conv(cfg["data_shape"], scaled_hidden(cfg["conv"]["hidden_size"], model_rate),
+                          cfg["classes_size"], norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"])
+    elif name in RESNET_BLOCKS:
+        num_blocks, bottleneck = RESNET_BLOCKS[name]
+        model = make_resnet(cfg["data_shape"], scaled_hidden(cfg["resnet"]["hidden_size"], model_rate),
+                            num_blocks, cfg["classes_size"], bottleneck=bottleneck,
+                            norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"])
+    elif name == "transformer":
+        t = cfg["transformer"]
+        model = make_transformer(
+            cfg["num_tokens"], ceil_width(t["embedding_size"], model_rate), t["num_heads"],
+            ceil_width(t["hidden_size"], model_rate), t["num_layers"], t["dropout"],
+            cfg["bptt"], cfg["mask_rate"], mask=cfg["mask"])
+    else:
+        raise ValueError("Not valid model name")
+    model.meta["model_rate"] = model_rate
+    model.meta["scaler_rate"] = scaler_rate
+    return model

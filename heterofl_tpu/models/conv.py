@@ -1,0 +1,98 @@
+"""The HeteroFL CNN: Conv3x3 -> Scaler -> Norm -> ReLU -> MaxPool x4, then
+GlobalAvgPool -> Linear, loss inside apply.
+
+Parity: ``src/models/conv.py`` (incl. the quirk that the *last* MaxPool is
+dropped, conv.py:56, and the zero-fill label mask, conv.py:66-69).  Width
+slicing rules mirror ``src/fed.py:27-62``: hidden channels are prefix-sliced
+and chained; the classifier keeps its full output dim (label-restricted at
+aggregation time only).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.layers import conv2d, cross_entropy, global_avg_pool, linear, masked_logits, max_pool2, scaler
+from .base import ModelDef, uniform_fan_in
+from .norms import apply_norm, norm_has_params, norm_init
+from .spec import Group, ParamSpec
+
+
+def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
+              scale: bool = True, mask: bool = True) -> ModelDef:
+    """Build the CNN at the given (global) widths.
+
+    ``hidden_size`` are the *constructed* widths: the global model passes
+    ``ceil(global_rate * [64,128,256,512])`` (ref models/conv.py:77); a sliced
+    sub-model passes its own smaller widths and runs with ``width_rate=1``.
+    """
+    in_ch = data_shape[-1]
+    n_blocks = len(hidden_size)
+
+    groups = {f"h{i}": Group(f"h{i}", hidden_size[i]) for i in range(n_blocks)}
+    groups["classes"] = Group("classes", classes_size, kind="full")
+
+    specs: Dict[str, ParamSpec] = {}
+    for i in range(n_blocks):
+        in_group = {} if i == 0 else {2: f"h{i-1}"}
+        specs[f"block{i}.conv.w"] = ParamSpec({**in_group, 3: f"h{i}"})
+        specs[f"block{i}.conv.b"] = ParamSpec({0: f"h{i}"})
+        if norm_has_params(norm):
+            specs[f"block{i}.norm.g"] = ParamSpec({0: f"h{i}"})
+            specs[f"block{i}.norm.b"] = ParamSpec({0: f"h{i}"})
+    specs["linear.w"] = ParamSpec({0: f"h{n_blocks-1}"}, label_axis=1)
+    specs["linear.b"] = ParamSpec({}, label_axis=0)
+
+    def init(key: jax.Array) -> Dict[str, jnp.ndarray]:
+        params: Dict[str, jnp.ndarray] = {}
+        keys = jax.random.split(key, 2 * n_blocks + 1)
+        ci = in_ch
+        for i in range(n_blocks):
+            co = hidden_size[i]
+            fan_in = 3 * 3 * ci
+            params[f"block{i}.conv.w"] = uniform_fan_in(keys[2 * i], (3, 3, ci, co), fan_in)
+            params[f"block{i}.conv.b"] = uniform_fan_in(keys[2 * i + 1], (co,), fan_in)
+            params.update({f"block{i}.norm.{n}": v for n, v in norm_init(norm, co).items()})
+            ci = co
+        params["linear.w"] = uniform_fan_in(keys[-1], (hidden_size[-1], classes_size), hidden_size[-1])
+        params["linear.b"] = jnp.zeros(classes_size, jnp.float32)  # ref models/utils.py:8
+        return params
+
+    def apply(params, batch, *, train: bool, width_rate=1.0, scaler_rate=1.0,
+              label_mask: Optional[jnp.ndarray] = None, bn_mode: str = "batch",
+              bn_state=None, sample_weight=None, rng=None):
+        x = batch["img"]
+        collected = {}
+        for i in range(n_blocks):
+            x = conv2d(x, params[f"block{i}.conv.w"], params[f"block{i}.conv.b"])
+            if scale:
+                x = scaler(x, scaler_rate, train)
+            g = groups[f"h{i}"]
+            site = f"block{i}.norm"
+            x, st = apply_norm(
+                norm, x, params.get(f"{site}.g"), params.get(f"{site}.b"),
+                mask=g.mask(width_rate), k=g.active_count(width_rate),
+                bn_mode=bn_mode, bn_running=None if bn_state is None else bn_state.get(site),
+                sample_weight=sample_weight)
+            if st is not None:
+                collected[site] = st
+            x = jax.nn.relu(x)
+            if i < n_blocks - 1:  # last pool dropped (ref conv.py:56)
+                x = max_pool2(x)
+        x = global_avg_pool(x)
+        out = linear(x, params["linear.w"], params["linear.b"])
+        out = masked_logits(out, label_mask, mask)
+        loss = cross_entropy(out, batch["label"], sample_weight)
+        return {"score": out, "loss": loss}, collected
+
+    bn_sites = [f"block{i}.norm" for i in range(n_blocks)] if norm == "bn" else []
+    meta = {
+        "bn_sizes": {f"block{i}.norm": hidden_size[i] for i in range(n_blocks)},
+        "hidden_size": list(hidden_size),
+        "classes_size": classes_size,
+        "kind": "conv",
+    }
+    return ModelDef("conv", init, apply, specs, groups, bn_sites, meta)

@@ -1,0 +1,122 @@
+"""Width groups and parameter slicing specs.
+
+The reference materialises per-client ``param_idx`` index tensors by walking
+the state_dict with model-family-specific rules (``src/fed.py:26-159``).  Here
+the same information is *declared once* per model as:
+
+* ``Group`` -- a named width axis of the global model (e.g. ResNet stage 2's
+  channels).  Given a client's ``width_rate`` it yields a 0/1 activity mask:
+  - ``prefix``: first ``ceil(size * rate)`` entries active (fed.py:46-48);
+  - ``per_head``: first ``ceil(head_dim * rate)`` entries of each attention
+    head active (fed.py:124-131);
+  - ``full``: always fully active (output layers, fed.py:43-44,85-87).
+* ``ParamSpec`` -- which group governs each axis of each parameter, plus the
+  axis (if any) restricted to the client's label split during aggregation
+  (fed.py:193-198,228-233,263-274).
+
+Everything is a pure function of a (possibly traced) ``width_rate`` scalar, so
+dynamic-mode rate re-sampling stays inside the jitted round.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Group:
+    name: str
+    size: int
+    kind: str = "prefix"  # "prefix" | "per_head" | "full"
+    num_heads: int = 1
+
+    def active_count(self, width_rate) -> jnp.ndarray:
+        """Number of active entries for a client at ``width_rate``."""
+        if self.kind == "full":
+            return jnp.asarray(self.size, jnp.int32)
+        if self.kind == "prefix":
+            return jnp.ceil(self.size * width_rate).astype(jnp.int32)
+        if self.kind == "per_head":
+            hd = self.size // self.num_heads
+            return (jnp.ceil(hd * width_rate).astype(jnp.int32) * self.num_heads).astype(jnp.int32)
+        raise ValueError(self.kind)
+
+    def mask(self, width_rate) -> jnp.ndarray:
+        """0/1 activity mask of shape ``[size]``."""
+        idx = jnp.arange(self.size)
+        if self.kind == "full":
+            return jnp.ones(self.size, jnp.float32)
+        if self.kind == "prefix":
+            k = jnp.ceil(self.size * width_rate)
+            return (idx < k).astype(jnp.float32)
+        if self.kind == "per_head":
+            hd = self.size // self.num_heads
+            kh = jnp.ceil(hd * width_rate)
+            return ((idx % hd) < kh).astype(jnp.float32)
+        raise ValueError(self.kind)
+
+
+@dataclass(frozen=True)
+class ParamSpec:
+    """Slicing rule for one parameter.
+
+    ``axis_groups`` maps tensor axis -> group name.  Unlisted axes are never
+    sliced.  ``label_axis`` marks the axis whose rows are restricted to the
+    client's label split when aggregating (None for most parameters).
+    """
+
+    axis_groups: Dict[int, str] = field(default_factory=dict)
+    label_axis: Optional[int] = None
+
+
+def axis_mask(shape: Tuple[int, ...], axis: int, vec: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a per-axis mask vector across a tensor shape."""
+    view = [1] * len(shape)
+    view[axis] = shape[axis]
+    return vec.reshape(view)
+
+
+def param_mask(shape: Tuple[int, ...], spec: ParamSpec, groups: Dict[str, Group],
+               width_rate, label_mask: Optional[jnp.ndarray] = None,
+               with_label: bool = False) -> jnp.ndarray:
+    """Activity mask for one parameter (product over its sliced axes).
+
+    With ``with_label=True`` the ``label_axis`` is additionally restricted by
+    ``label_mask`` -- this is the aggregation-time *count* mask; without it,
+    the distribute-time parameter mask.
+    """
+    m = jnp.ones((), jnp.float32)
+    for axis, gname in spec.axis_groups.items():
+        m = m * axis_mask(shape, axis, groups[gname].mask(width_rate))
+    if with_label and spec.label_axis is not None and label_mask is not None:
+        vec = label_mask.astype(jnp.float32)
+        short = shape[spec.label_axis] - vec.shape[0]
+        if short > 0:
+            # e.g. the transformer's <mask>-token embedding row (vocab+1):
+            # outside every label split, never aggregated (ref fed.py:263-268).
+            vec = jnp.concatenate([vec, jnp.zeros(short, jnp.float32)])
+        m = m * axis_mask(shape, spec.label_axis, vec)
+    return jnp.broadcast_to(m, shape) if m.ndim else jnp.full(shape, m)
+
+
+def mask_params(params: Dict[str, jnp.ndarray], specs: Dict[str, ParamSpec],
+                groups: Dict[str, Group], width_rate) -> Dict[str, jnp.ndarray]:
+    """Zero the inactive entries of every parameter (distribute-time mask).
+
+    Equivalent to the reference's sub-model extraction (fed.py:165-178): the
+    active prefix holds the global values, everything else is zero.
+    """
+    return {k: v * param_mask(v.shape, specs[k], groups, width_rate) for k, v in params.items()}
+
+
+def count_masks(params_shapes: Dict[str, Tuple[int, ...]], specs: Dict[str, ParamSpec],
+                groups: Dict[str, Group], width_rate,
+                label_mask: Optional[jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Aggregation-time contribution masks (label-restricted)."""
+    return {
+        k: param_mask(shape, specs[k], groups, width_rate, label_mask, with_label=True)
+        for k, shape in params_shapes.items()
+    }

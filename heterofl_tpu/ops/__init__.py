@@ -1,0 +1,16 @@
+"""Device-side primitive ops: masked layers, pooling, losses, augmentation."""
+
+from .layers import (  # noqa: F401
+    conv2d,
+    linear,
+    embed,
+    scaler,
+    batch_norm,
+    masked_layer_norm,
+    dynamic_group_norm,
+    max_pool2,
+    global_avg_pool,
+    cross_entropy,
+    masked_logits,
+)
+from .augment import normalize_image, augment_cifar  # noqa: F401

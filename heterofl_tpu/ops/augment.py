@@ -1,0 +1,37 @@
+"""On-device data augmentation + normalisation.
+
+The reference normalises/augments on the host via torchvision transforms
+(``src/data.py:15-27``: CIFAR train = RandomCrop(32, padding=4) +
+RandomHorizontalFlip).  Here raw uint8 batches are shipped to the device once
+and augmentation runs inside the jitted client step, fusing into the forward
+pass -- no host round-trips in the training loop.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize_image(x: jnp.ndarray, mean: Sequence[float], std: Sequence[float]) -> jnp.ndarray:
+    """uint8 NHWC -> float32 normalised (ToTensor + Normalize parity)."""
+    x = x.astype(jnp.float32) / 255.0
+    return (x - jnp.asarray(mean, jnp.float32)) / jnp.asarray(std, jnp.float32)
+
+
+def augment_cifar(rng: jax.Array, x: jnp.ndarray) -> jnp.ndarray:
+    """RandomCrop(pad=4) + RandomHorizontalFlip on a uint8/float NHWC batch."""
+    n, h, w, c = x.shape
+    k_shift, k_flip = jax.random.split(rng)
+    pad = 4
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    shifts = jax.random.randint(k_shift, (n, 2), 0, 2 * pad + 1)
+
+    def crop_one(img, sh):
+        return jax.lax.dynamic_slice(img, (sh[0], sh[1], 0), (h, w, c))
+
+    out = jax.vmap(crop_one)(xp, shifts)
+    flip = jax.random.bernoulli(k_flip, 0.5, (n,))
+    return jnp.where(flip[:, None, None, None], out[:, :, ::-1, :], out)

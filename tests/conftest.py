@@ -1,0 +1,33 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX loads.
+
+This is the TPU-native analogue of a fake distributed backend (SURVEY.md §4):
+multi-chip sharding is validated on a virtual CPU mesh via
+``--xla_force_host_platform_device_count``.
+
+NOTE: this environment boots a TPU-tunnel PJRT plugin via sitecustomize that
+pins ``jax_platforms`` and hangs CPU-only init; we scrub its env hooks and
+re-pin the platform to cpu before any backend initialises.
+"""
+
+import os
+
+for _v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+           "AXON_LOOPBACK_RELAY", "AXON_POOL_SVC_OVERRIDE"):
+    os.environ.pop(_v, None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

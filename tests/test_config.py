@@ -1,0 +1,91 @@
+import numpy as np
+import pytest
+
+from heterofl_tpu import config as C
+
+
+def _cfg(control_name, data_name="CIFAR10", model_name="resnet18"):
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name(control_name)
+    cfg["data_name"] = data_name
+    cfg["model_name"] = model_name
+    return C.process_control(cfg)
+
+
+def test_control_roundtrip():
+    s = "1_100_0.1_iid_fix_a2-b8_bn_1_1"
+    ctl = C.parse_control_name(s)
+    assert C.control_name_of(ctl) == s
+    assert ctl["model_mode"] == "a2-b8"
+
+
+def test_control_bad_arity():
+    with pytest.raises(ValueError):
+        C.parse_control_name("1_100_0.1")
+
+
+def test_fix_rate_vector_proportional_fill():
+    # a2-b8 with 100 users: sum(prop)=10 -> 10 users/unit -> 20 a's, 80 b's.
+    cfg = _cfg("1_100_0.1_iid_fix_a2-b8_bn_1_1")
+    rates = cfg["model_rate"]
+    assert len(rates) == 100
+    assert rates[:20] == [1.0] * 20
+    assert rates[20:] == [0.5] * 80
+
+
+def test_fix_rate_vector_remainder_gets_smallest():
+    # a1-b1-c1 with 100 users: 33 users/unit -> 99 assigned, 1 leftover -> c.
+    cfg = _cfg("1_100_0.1_iid_fix_a1-b1-c1_bn_1_1")
+    rates = cfg["model_rate"]
+    assert len(rates) == 100
+    assert rates[:33] == [1.0] * 33
+    assert rates[33:66] == [0.5] * 33
+    assert rates[66:99] == [0.25] * 33
+    assert rates[99] == 0.25
+
+
+def test_five_level_fix():
+    cfg = _cfg("1_100_0.1_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    rates = cfg["model_rate"]
+    assert len(rates) == 100
+    assert rates.count(1.0) == 20 and rates.count(0.0625) == 20
+
+
+def test_dynamic_mode_stores_distribution():
+    cfg = _cfg("1_100_0.1_iid_dynamic_a1-e1_bn_1_1")
+    assert cfg["model_rate"] == [1.0, 0.0625]
+    assert np.allclose(cfg["proportion"], [0.5, 0.5])
+
+
+def test_global_rate_is_first_level():
+    cfg = _cfg("1_100_0.1_iid_fix_b1-c1_bn_1_1")
+    assert cfg["global_model_rate"] == 0.5
+    assert cfg["global_model_mode"] == "b"
+
+
+def test_dataset_tables():
+    cfg = _cfg("1_100_0.1_iid_fix_a1_bn_1_1", data_name="MNIST", model_name="conv")
+    assert cfg["num_epochs"] == {"global": 200, "local": 5}
+    assert cfg["lr"] == 1e-2 and cfg["milestones"] == [100]
+    cfg = _cfg("1_100_0.1_non-iid-2_fix_a1_bn_1_1")
+    assert cfg["num_epochs"]["global"] == 800 and cfg["milestones"] == [300, 500]
+    cfg = _cfg("1_100_0.01_iid_fix_a1_bn_1_1", data_name="WikiText2", model_name="transformer")
+    assert cfg["bptt"] == 64 and cfg["mask_rate"] == 0.15
+    assert cfg["num_epochs"] == {"global": 200, "local": 1}
+
+
+def test_flags_parsed():
+    cfg = _cfg("1_100_0.1_iid_fix_a1_bn_0_0")
+    assert cfg["scale"] is False and cfg["mask"] is False
+    cfg = _cfg("1_100_0.1_iid_fix_a1_gn_1_1")
+    assert cfg["norm"] == "gn"
+
+
+def test_model_tag():
+    cfg = _cfg("1_100_0.1_iid_fix_a1_bn_1_1")
+    assert C.make_model_tag(0, cfg) == "0_CIFAR10_label_resnet18_1_100_0.1_iid_fix_a1_bn_1_1"
+
+
+def test_ceil_width():
+    assert C.scaled_hidden([64, 128, 256, 512], 0.0625) == [4, 8, 16, 32]
+    assert C.ceil_width(250, 0.125) == 32

@@ -1,0 +1,168 @@
+"""Federation algebra: distribute/combine identities, nesting, counted
+averaging, label-split restriction, stale-value fallback (ref fed.py:180-298)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heterofl_tpu import config as C
+from heterofl_tpu.fed import (
+    active_indices,
+    client_count_masks,
+    combine_counted,
+    distribute_masked,
+    embed_sliced,
+    extract_sliced,
+    sample_model_rates,
+)
+from heterofl_tpu.models import make_model
+from heterofl_tpu.models.spec import mask_params
+
+from test_models import small_cfg
+
+
+def _model_and_params(model_name="conv", **kw):
+    cfg = small_cfg(model_name, **kw)
+    m = make_model(cfg)
+    p = m.init(jax.random.key(0))
+    return cfg, m, p
+
+
+def test_nesting_invariant():
+    """rate r's active set is a subset of rate r' for every r < r' (every group)."""
+    _, m, p = _model_and_params("resnet18")
+    rates = [0.0625, 0.125, 0.25, 0.5, 1.0]
+    for g in m.groups.values():
+        for lo, hi in zip(rates, rates[1:]):
+            a, b = set(active_indices(g, lo).tolist()), set(active_indices(g, hi).tolist())
+            assert a <= b, f"group {g.name}: {lo} not nested in {hi}"
+
+
+def test_extract_embed_matches_mask():
+    """embed_sliced(extract_sliced(p)) == mask_params(p): the sliced and masked
+    views of distribute are the same object."""
+    _, m, p = _model_and_params("conv")
+    rate = 0.25
+    pn = {k: np.asarray(v) for k, v in p.items()}
+    sliced = extract_sliced(pn, m.specs, m.groups, rate)
+    back = embed_sliced(sliced, m.specs, m.groups, rate, {k: v.shape for k, v in pn.items()})
+    masked = mask_params(p, m.specs, m.groups, rate)
+    for k in pn:
+        np.testing.assert_allclose(back[k], np.asarray(masked[k]), err_msg=k)
+
+
+def test_combine_identity_homogeneous():
+    """All clients at rate 1 with unchanged params -> global unchanged."""
+    _, m, p = _model_and_params("conv")
+    lm = jnp.ones(10)
+    n_clients = 3
+    summed = {k: jnp.zeros_like(v) for k, v in p.items()}
+    counts = {k: jnp.zeros_like(v) for k, v in p.items()}
+    for _ in range(n_clients):
+        cm = client_count_masks(p, m, 1.0, lm)
+        local = distribute_masked(p, m, 1.0)
+        summed = {k: summed[k] + local[k] * cm[k] for k in p}
+        counts = {k: counts[k] + cm[k] for k in p}
+    new = combine_counted(p, summed, counts)
+    for k in p:
+        np.testing.assert_allclose(np.asarray(new[k]), np.asarray(p[k]), rtol=1e-6, err_msg=k)
+
+
+def test_combine_counted_average_and_stale():
+    """Two clients at rates 1 and 0.5 with constant deltas: overlap averages,
+    exclusive region takes the sole contributor, untouched keeps global."""
+    _, m, p = _model_and_params("conv")
+    lm = jnp.ones(10)
+    k = "block1.conv.w"  # [3,3,8,16], group h0=8 in, h1=16 out
+    c1 = {k2: jnp.full_like(v, 2.0) for k2, v in p.items()}
+    c2_full = {k2: jnp.full_like(v, 4.0) for k2, v in p.items()}
+    c1m = {k2: c1[k2] * (distribute_masked(p, m, 1.0)[k2] * 0 + 1) for k2 in p}  # rate 1: no mask
+    c2m = mask_params(c2_full, m.specs, m.groups, 0.5)
+    cm1 = client_count_masks(p, m, 1.0, lm)
+    cm2 = client_count_masks(p, m, 0.5, lm)
+    summed = {k2: c1m[k2] * cm1[k2] + c2m[k2] * cm2[k2] for k2 in p}
+    counts = {k2: cm1[k2] + cm2[k2] for k2 in p}
+    new = combine_counted(p, summed, counts)
+    w = np.asarray(new[k])
+    # overlap: first 4 in-ch x first 8 out-ch -> (2+4)/2 = 3
+    assert np.allclose(w[:, :, :4, :8], 3.0)
+    # only client1 (rate 1) holds the suffix -> 2
+    assert np.allclose(w[:, :, 4:, :], 2.0)
+    assert np.allclose(w[:, :, :4, 8:], 2.0)
+
+
+def test_label_split_restricts_output_rows():
+    """Client labels restrict which classifier rows it contributes
+    (ref fed.py:193-198): other rows keep the global value."""
+    _, m, p = _model_and_params("conv")
+    lm = jnp.zeros(10).at[jnp.array([1, 3])].set(1.0)
+    local = {k: jnp.full_like(v, 7.0) for k, v in p.items()}
+    cm = client_count_masks(p, m, 1.0, lm)
+    summed = {k: local[k] * cm[k] for k in p}
+    counts = dict(cm)
+    new = combine_counted(p, summed, counts)
+    wb = np.asarray(new["linear.b"])
+    assert np.allclose(wb[[1, 3]], 7.0)
+    np.testing.assert_allclose(wb[[0, 2, 4, 5, 6, 7, 8, 9]],
+                               np.asarray(p["linear.b"])[[0, 2, 4, 5, 6, 7, 8, 9]])
+    ww = np.asarray(new["linear.w"])  # [hidden, classes], label axis 1
+    assert np.allclose(ww[:, [1, 3]], 7.0)
+    np.testing.assert_allclose(ww[:, [0, 2]], np.asarray(p["linear.w"])[:, [0, 2]])
+
+
+def test_transformer_label_split_on_embedding_and_decoder():
+    cfg = small_cfg("transformer", data_name="WikiText2")
+    m = make_model(cfg)
+    p = m.init(jax.random.key(0))
+    lm = jnp.zeros(50).at[jnp.array([5])].set(1.0)
+    local = {k: jnp.full_like(v, 9.0) for k, v in p.items()}
+    cm = client_count_masks(p, m, 1.0, lm)
+    new = combine_counted(p, {k: local[k] * cm[k] for k in p}, dict(cm))
+    tok = np.asarray(new["embedding.tok.w"])  # [51, E] label axis 0
+    assert np.allclose(tok[5], 9.0)
+    np.testing.assert_allclose(tok[6], np.asarray(p["embedding.tok.w"])[6])
+    # the <mask> token row (id 50) is never aggregated
+    np.testing.assert_allclose(tok[50], np.asarray(p["embedding.tok.w"])[50])
+    dec = np.asarray(new["dec.l2.w"])  # [E, V] label axis 1
+    assert np.allclose(dec[:, 5], 9.0)
+    np.testing.assert_allclose(dec[:, 6], np.asarray(p["dec.l2.w"])[:, 6])
+    # positional embedding has no label restriction
+    assert np.allclose(np.asarray(new["embedding.pos.w"]), 9.0)
+
+
+def test_fix_rates_indexed_by_user_ids():
+    """Partial participation must pick the *selected* users' rates
+    (ref fed.py self.model_rate[user_idx[m]]), not the first-n users'."""
+    cfg = small_cfg("conv", control="1_10_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    # users 0-1 -> a, 2-3 -> b, 4-5 -> c, 6-7 -> d, 8-9 -> e
+    r = sample_model_rates(jax.random.key(0), cfg, jnp.array([9, 0, 4]))
+    np.testing.assert_allclose(np.asarray(r), [0.0625, 1.0, 0.25])
+
+
+def test_non_a_global_mode_width_rates():
+    """Global mode 'b': group sizes are already halved, so masks must use the
+    relative rate model_rate/global_rate (ref fed.py:46), not the absolute."""
+    from heterofl_tpu.fed import to_width_rates
+
+    cfg = small_cfg("conv", control="1_10_0.5_iid_fix_b1-c1_bn_1_1")
+    assert cfg["global_model_rate"] == 0.5
+    m = make_model(cfg)  # built at rate 0.5: hidden [8,16] -> [4,8]
+    assert m.groups["h0"].size == 4 and m.groups["h1"].size == 8
+    rates = sample_model_rates(jax.random.key(0), cfg, jnp.array([0, 9]))
+    wr = np.asarray(to_width_rates(rates, cfg))
+    np.testing.assert_allclose(wr, [1.0, 0.5])
+    # a 'b' client at width_rate 1.0 is the FULL global model
+    assert int(m.groups["h1"].active_count(wr[0])) == 8
+    # a 'c' client gets ceil(8*0.5)=4 channels, matching ceil(16*0.25)
+    assert int(m.groups["h1"].active_count(wr[1])) == 4
+
+
+def test_sample_model_rates_fix_and_dynamic():
+    cfg = small_cfg("conv", control="1_10_0.5_iid_fix_a1-b1_bn_1_1")
+    r = sample_model_rates(jax.random.key(0), cfg)
+    assert r.shape == (10,)
+    assert np.allclose(np.asarray(r)[:5], 1.0) and np.allclose(np.asarray(r)[5:], 0.5)
+    cfg_d = small_cfg("conv", control="1_10_0.5_iid_dynamic_a1-e1_bn_1_1")
+    draws = np.asarray(sample_model_rates(jax.random.key(1), cfg_d, jnp.arange(1000)))
+    assert set(np.unique(draws).tolist()) <= {1.0, 0.0625}
+    assert 0.35 < np.mean(draws == 1.0) < 0.65

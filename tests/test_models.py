@@ -1,0 +1,105 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heterofl_tpu import config as C
+from heterofl_tpu.models import make_model
+
+
+def small_cfg(model_name="conv", data_name="MNIST", norm="bn", control="1_10_0.5_iid_fix_a1_bn_1_1"):
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name(control)
+    cfg["control"]["norm"] = norm
+    cfg["data_name"] = data_name
+    cfg["model_name"] = model_name
+    cfg = C.process_control(cfg)
+    # shrink for CPU tests
+    cfg["conv"] = {"hidden_size": [8, 16]}
+    cfg["resnet"] = {"hidden_size": [8, 16, 16, 16]}
+    cfg["transformer"] = {"embedding_size": 32, "num_heads": 4, "hidden_size": 64,
+                          "num_layers": 2, "dropout": 0.0}
+    cfg["classes_size"] = 10
+    cfg["num_tokens"] = 50
+    if "bptt" not in cfg:
+        cfg["bptt"] = 16
+        cfg["mask_rate"] = 0.15
+    return cfg
+
+
+def vision_batch(cfg, n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = tuple(cfg["data_shape"])
+    return {
+        "img": jnp.asarray(rng.normal(size=(n,) + shape), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, cfg["classes_size"], n)),
+    }
+
+
+@pytest.mark.parametrize("model_name", ["conv", "resnet18", "resnet50"])
+@pytest.mark.parametrize("norm", ["bn", "in", "ln", "gn", "none"])
+def test_vision_smoke(model_name, norm):
+    cfg = small_cfg(model_name, norm=norm)
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    batch = vision_batch(cfg)
+    out, collected = model.apply(params, batch, train=True)
+    assert out["score"].shape == (4, 10)
+    assert jnp.isfinite(out["loss"])
+    if norm == "bn":
+        out2, col = model.apply(params, batch, train=True, bn_mode="collect")
+        assert len(col) == len(model.bn_sites) > 0
+        state = {k: v for k, v in col.items()}
+        out3, _ = model.apply(params, batch, train=False, bn_mode="running", bn_state=state)
+        assert jnp.isfinite(out3["loss"])
+
+
+def test_transformer_smoke():
+    cfg = small_cfg("transformer", data_name="WikiText2")
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    labels = jnp.asarray(np.random.default_rng(0).integers(0, 50, (2, 16)))
+    out, _ = model.apply(params, {"label": labels}, train=True, rng=jax.random.key(1))
+    assert out["score"].shape == (2, 16, 50)
+    assert jnp.isfinite(out["loss"])
+
+
+def test_label_mask_zero_fill():
+    cfg = small_cfg("conv")
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    batch = vision_batch(cfg)
+    lm = jnp.zeros(10).at[jnp.array([1, 3])].set(1.0)
+    out, _ = model.apply(params, batch, train=True, label_mask=lm)
+    score = np.asarray(out["score"])
+    masked_cols = [c for c in range(10) if c not in (1, 3)]
+    assert np.all(score[:, masked_cols] == 0.0)
+    assert np.any(score[:, [1, 3]] != 0.0)
+
+
+def test_scaler_train_only():
+    cfg = small_cfg("conv")
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    batch = vision_batch(cfg)
+    # with norm='none' the scaler changes the forward; check train != eval scale behavior
+    cfg2 = small_cfg("conv", norm="none")
+    m2 = make_model(cfg2)
+    p2 = m2.init(jax.random.key(0))
+    o_tr, _ = m2.apply(p2, batch, train=True, scaler_rate=0.5)
+    o_ev, _ = m2.apply(p2, batch, train=False, scaler_rate=0.5)
+    assert not np.allclose(o_tr["score"], o_ev["score"])
+
+
+def test_sample_weight_neutralises_padding():
+    cfg = small_cfg("conv", norm="none")
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    b4 = vision_batch(cfg, n=4)
+    # pad with junk + zero weight -> same loss as unpadded
+    img6 = jnp.concatenate([b4["img"], 100.0 * jnp.ones((2,) + b4["img"].shape[1:])])
+    lab6 = jnp.concatenate([b4["label"], jnp.zeros(2, b4["label"].dtype)])
+    w = jnp.array([1, 1, 1, 1, 0, 0], jnp.float32)
+    o4, _ = model.apply(params, b4, train=True)
+    o6, _ = model.apply(params, {"img": img6, "label": lab6}, train=True, sample_weight=w)
+    assert np.allclose(o4["loss"], o6["loss"], rtol=1e-5)
