@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Headline benchmark: federated rounds/sec on the BASELINE.json config --
+100-client CIFAR10 ResNet-18, 5-level heterogeneity a1-b1-c1-d1-e1, 10 active
+clients x 5 local epochs x 50 steps per round, full HeteroFL semantics
+(masked widths, Scaler, sBN-free local BN, label masks, counted-average
+aggregation), all inside one jitted round program.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is rounds/sec relative to the 10 rounds/sec north star
+(BASELINE.json; the reference itself publishes no wall-clock numbers).
+
+Env knobs: BENCH_ROUNDS (timed rounds, default 5), BENCH_USERS (default 100),
+BENCH_SYNTH_N (train images, default 50000), BENCH_CPU=1 to force the
+virtual-CPU path (debug).
+"""
+
+import json
+import os
+import sys
+import time
+
+if os.environ.get("BENCH_CPU") == "1":
+    for _v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+               "AXON_LOOPBACK_RELAY", "AXON_POOL_SVC_OVERRIDE"):
+        os.environ.pop(_v, None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from heterofl_tpu import config as C
+    from heterofl_tpu.data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
+    from heterofl_tpu.models import make_model
+    from heterofl_tpu.parallel import RoundEngine, make_mesh
+
+    users = int(os.environ.get("BENCH_USERS", "100"))
+    n_train = int(os.environ.get("BENCH_SYNTH_N", "50000"))
+    timed_rounds = int(os.environ.get("BENCH_ROUNDS", "5"))
+
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    cfg["data_name"] = "CIFAR10"
+    cfg["model_name"] = "resnet18"
+    cfg["synthetic"] = True
+    cfg = C.process_control(cfg)
+
+    hidden = os.environ.get("BENCH_HIDDEN")
+    if hidden:  # debug-only shrink, e.g. BENCH_HIDDEN=8,16,16,16
+        cfg["resnet"] = {"hidden_size": [int(h) for h in hidden.split(",")]}
+
+    ds = fetch_dataset("CIFAR10", synthetic=True, seed=0,
+                       synthetic_sizes={"train": n_train, "test": 1000})
+    rng = np.random.default_rng(0)
+    split, lsplit = split_dataset(ds, users, "iid", rng)
+    x, y, m = stack_client_shards(ds["train"].data, ds["train"].target, split["train"],
+                                  list(range(users)))
+    lm = label_split_masks(lsplit, users, 10)
+    cfg["classes_size"] = 10
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    mesh = make_mesh(len(jax.devices()), 1)
+    engine = RoundEngine(model, cfg, mesh)
+    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+
+    n_active = int(np.ceil(cfg["frac"] * users))
+    def round_once(params, r):
+        user_idx = rng.permutation(users)[:n_active].astype(np.int32)
+        params, ms = engine.train_round(params, jax.random.key(r), 0.1, user_idx, data)
+        return params, ms
+
+    # compile + warmup
+    t0 = time.time()
+    params, ms = round_once(params, 0)
+    jax.block_until_ready(params)
+    compile_s = time.time() - t0
+    # timed
+    t0 = time.time()
+    for r in range(1, timed_rounds + 1):
+        params, ms = round_once(params, r)
+    jax.block_until_ready(params)
+    dt = (time.time() - t0) / timed_rounds
+    rps = 1.0 / dt
+
+    loss = float(np.asarray(ms["loss_sum"]).sum() / np.asarray(ms["n"]).sum())
+    print(json.dumps({
+        "metric": "federated_rounds_per_sec_cifar10_resnet18_a1-e1_100c",
+        "value": round(rps, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rps / 10.0, 4),
+        "extra": {"round_sec": round(dt, 3), "compile_sec": round(compile_s, 1),
+                  "devices": len(jax.devices()), "platform": jax.devices()[0].platform,
+                  "active_clients": n_active, "final_loss": round(loss, 4)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

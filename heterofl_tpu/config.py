@@ -82,6 +82,11 @@ DEFAULT_CFG: Dict[str, Any] = {
     "data_dir": "./data",
     "output_dir": "./output",
     "synthetic": False,  # force synthetic data (offline/testing)
+    "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
+    # Applied LAST by process_control: per-key overrides of any derived field
+    # (dict values merge shallowly). E.g. {"num_epochs": {"global": 2},
+    # "conv": {"hidden_size": [8, 16]}} -- used by tests and bench harnesses.
+    "override": {},
 }
 
 
@@ -245,6 +250,11 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
             raise ValueError("Not valid data_split_mode")
     else:
         raise ValueError("Not valid dataset")
+    for k, v in (cfg.get("override") or {}).items():
+        if isinstance(v, dict) and isinstance(cfg.get(k), dict):
+            cfg[k] = {**cfg[k], **v}
+        else:
+            cfg[k] = v
     return cfg
 
 

@@ -11,6 +11,7 @@ from .pipeline import (  # noqa: F401
     process_dataset,
     batchify,
     bptt_windows,
+    stack_windows,
     stack_client_shards,
     stack_client_token_rows,
     label_split_masks,

@@ -49,6 +49,25 @@ def bptt_windows(rows: np.ndarray, bptt: int) -> List[np.ndarray]:
     return [rows[:, s: s + bptt] for s in range(0, rows.shape[1], bptt)]
 
 
+def stack_windows(wins: List[np.ndarray], bptt: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Stack bptt windows into ``([S, R, bptt], weights)``; a short tail
+    window is zero-padded with zero position weights."""
+    full = [w for w in wins if w.shape[1] == bptt]
+    if full:
+        xs = np.stack(full)
+    else:
+        r = wins[0].shape[0] if wins else 0
+        xs = np.zeros((0, r, bptt), np.int64)
+    ws = np.ones(xs.shape, np.float32)
+    tail = wins[-1] if wins and wins[-1].shape[1] < bptt else None
+    if tail is not None:
+        pad = bptt - tail.shape[1]
+        xs = np.concatenate([xs, np.pad(tail, ((0, 0), (0, pad)))[None]], 0)
+        ws = np.concatenate([ws, np.pad(np.ones(tail.shape, np.float32),
+                                        ((0, 0), (0, pad)))[None]], 0)
+    return xs, ws
+
+
 def stack_client_shards(data: np.ndarray, target: np.ndarray,
                         data_split: Dict[int, List[int]], user_idx: List[int]
                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

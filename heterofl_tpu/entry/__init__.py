@@ -1,0 +1,2 @@
+"""Entry points mirroring the reference's L5 drivers
+(``src/train_{classifier,transformer}{,_fed}.py``, ``src/test_*``)."""

@@ -1,0 +1,98 @@
+"""Evaluation entry points (the reference's ``test_*.py`` drivers).
+
+Parity: ``src/test_classifier_fed.py`` (§3.6 of SURVEY.md): load the best
+checkpoint, re-run sBN recalibration over the train set, evaluate Local +
+Global metrics, and bundle them to ``output/result/{tag}.pkl`` -- the input
+to the result-aggregation tooling (:mod:`heterofl_tpu.analysis.process`).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import config as C
+from ..utils import Logger, load_checkpoint, checkpoint_path, summarize_sums
+from .common import FedExperiment, build_cli, cfg_from_args
+
+
+def evaluate_experiment(cfg: Dict[str, Any], seed: int, load_tag: str = "best") -> Dict[str, Any]:
+    if cfg["control"].get("data_split_mode") == "none":
+        return _evaluate_central(cfg, seed, load_tag)
+    exp = FedExperiment(cfg, seed)
+    path = checkpoint_path(cfg["output_dir"], exp.tag, load_tag)
+    if not os.path.exists(path):
+        raise SystemExit(f"Not exists model tag: {exp.tag} "
+                         f"(expected checkpoint at {path}) -- train first")
+    blob = load_checkpoint(path)
+    params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+    data_split, label_split = blob["data_split"], blob["label_split"]
+    exp.stage(data_split, label_split)
+    logger = Logger(os.path.join(cfg["output_dir"], "runs", f"test_{exp.tag}"))
+    logger.safe(True)
+    named_global = exp.evaluate(params, blob.get("epoch", 0), logger, label_split)
+    logger.safe(False)
+    result = {
+        "cfg": {k: v for k, v in exp.cfg.items() if k != "vocab"},
+        "epoch": blob.get("epoch"),
+        "logger_history": dict(logger.history),
+        "train_history": blob.get("logger_history", {}),
+    }
+    out_path = os.path.join(cfg["output_dir"], "result", f"{exp.tag}.pkl")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "wb") as f:
+        pickle.dump(result, f)
+    print(f"saved result bundle: {out_path}")
+    return result
+
+
+def _evaluate_central(cfg: Dict[str, Any], seed: int, load_tag: str) -> Dict[str, Any]:
+    from .central import CentralExperiment, _batch_pad, _stack_windows
+    from ..data import bptt_windows
+
+    exp = CentralExperiment(cfg, seed)
+    cfg = exp.cfg
+    blob = load_checkpoint(checkpoint_path(cfg["output_dir"], exp.tag, load_tag))
+    params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+    if exp.kind == "vision":
+        xs, ws = _batch_pad(exp.dataset["train"].data, cfg["batch_size"]["train"])
+        bn = exp.evaluator.sbn_stats(params, xs, ws)
+        te = exp.dataset["test"]
+        xg, wg = _batch_pad(te.data, cfg["batch_size"]["test"])
+        yg, _ = _batch_pad(te.target, cfg["batch_size"]["test"])
+        g = exp.evaluator.eval_global(params, bn, xg, yg, wg)
+    else:
+        xs, ws = _stack_windows(bptt_windows(exp.dataset["test"].token, cfg["bptt"]), cfg["bptt"])
+        g = exp.evaluator.eval_global(params, {}, xs, ws)
+    named = summarize_sums({k: np.asarray(v) for k, v in g.items()}, cfg["model_name"], prefix="")
+    result = {"cfg": {k: v for k, v in cfg.items() if k != "vocab"},
+              "epoch": blob.get("epoch"), "metrics": named,
+              "train_history": blob.get("logger_history", {})}
+    out_path = os.path.join(cfg["output_dir"], "result", f"{exp.tag}.pkl")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "wb") as f:
+        pickle.dump(result, f)
+    print(f"saved result bundle: {out_path}  {named}")
+    return result
+
+
+def run_test_main(description: str, model_default: str, data_default: str,
+                  argv: Optional[List[str]] = None):
+    parser = build_cli(description)
+    args = parser.parse_args(argv)
+    cfg = cfg_from_args(args)
+    if args.model_name is None:
+        cfg["model_name"] = model_default
+    if args.data_name is None:
+        cfg["data_name"] = data_default
+    cfg = C.process_control(cfg)
+    results = []
+    for i in range(cfg["num_experiments"]):
+        seed = cfg["init_seed"] + i
+        results.append(evaluate_experiment(cfg, seed))
+    return results

@@ -1,0 +1,11 @@
+"""Evaluation driver (parity: ``src/test_classifier.py``)."""
+
+from .evaluate import run_test_main
+
+
+def main(argv=None):
+    return run_test_main("heterofl-tpu test_classifier", "resnet18", "CIFAR10", argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Centralized vision baseline (parity: ``src/train_classifier.py``)."""
+
+from .central import run_central_main
+
+
+def main(argv=None):
+    return run_central_main("heterofl-tpu centralized classifier", "resnet18", "CIFAR10",
+                            pivot_metric="Accuracy", pivot_mode="max", argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Federated vision training (flagship entry point).
+
+Parity: ``src/train_classifier_fed.py`` -- per round: sample
+``ceil(frac * num_users)`` users, heterogeneous local SGD, counted-average
+aggregation, sBN recalibration, Local+Global eval, MultiStep LR, checkpoint +
+best copy pivoted on Global-Accuracy.  The whole round is one XLA program
+(see parallel/round_engine.py).
+"""
+
+from .common import run_main
+
+
+def main(argv=None):
+    return run_main("heterofl-tpu federated classifier", "resnet18", "CIFAR10",
+                    pivot_metric="Global-Accuracy", pivot_mode="max", argv=argv)
+
+
+if __name__ == "__main__":
+    main()

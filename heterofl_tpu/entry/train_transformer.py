@@ -1,0 +1,12 @@
+"""Centralized masked-LM baseline (parity: ``src/train_transformer.py``)."""
+
+from .central import run_central_main
+
+
+def main(argv=None):
+    return run_central_main("heterofl-tpu centralized transformer", "transformer", "WikiText2",
+                            pivot_metric="Perplexity", pivot_mode="min", argv=argv)
+
+
+if __name__ == "__main__":
+    main()

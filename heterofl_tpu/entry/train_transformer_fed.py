@@ -1,0 +1,17 @@
+"""Federated masked-LM training.
+
+Parity: ``src/train_transformer_fed.py`` -- no sBN recalibration, global
+metrics only, pivot = minimised Global-Perplexity
+(ref train_transformer_fed.py:31-32, 90).
+"""
+
+from .common import run_main
+
+
+def main(argv=None):
+    return run_main("heterofl-tpu federated transformer", "transformer", "WikiText2",
+                    pivot_metric="Global-Perplexity", pivot_mode="min", argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -76,8 +76,9 @@ def _weighted_moments(x: jnp.ndarray, axes, weight: Optional[jnp.ndarray] = None
         var = jnp.sum((x - mean) ** 2 * 1.0, axis=axes, keepdims=True) / n
         return mean, var, n
     n = jnp.sum(weight, axis=axes, keepdims=True) if count is None else count
-    mean = jnp.sum(x * weight, axis=axes, keepdims=True) / n
-    var = jnp.sum(weight * (x - mean) ** 2, axis=axes, keepdims=True) / n
+    d = jnp.maximum(n, 1e-6)  # all-zero-weight (padded) batches: 0-stats, not NaN
+    mean = jnp.sum(x * weight, axis=axes, keepdims=True) / d
+    var = jnp.sum(weight * (x - mean) ** 2, axis=axes, keepdims=True) / d
     return mean, var, n
 
 

@@ -1,0 +1,215 @@
+"""Jitted evaluation programs: sBN recalibration and test metrics.
+
+sBN ("static batch norm"): federated training runs BN without running stats;
+before each evaluation the aggregated global model does one no-grad pass over
+the train set with fresh cumulative running statistics (momentum=None CMA),
+ref train_classifier_fed.py:127-138.  Here that pass is a ``lax.scan`` over
+batches with the batch axis sharded across all mesh devices (``psum`` of
+partial sums) -- the whole recalibration is one XLA program.
+
+Evaluation mirrors ref train_classifier_fed.py:141-168: "Local" = per-user
+test shards with that user's label mask; "Global" = full test set, no mask.
+Users are vmapped and sharded over the ``clients`` axis like the train round.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..data.datasets import DATASET_STATS
+from ..models.base import ModelDef
+from .round_engine import _ceil_div, _shard_map
+
+
+class Evaluator:
+    def __init__(self, model: ModelDef, cfg: Dict[str, Any], mesh):
+        self.model = model
+        self.cfg = cfg
+        self.mesh = mesh
+        self.is_lm = model.meta.get("kind") == "transformer"
+        self.norm_stats = DATASET_STATS.get(cfg["data_name"])
+        self.bptt = cfg.get("bptt", 64)
+        self._sbn = None
+        self._users = None
+        self._global = None
+
+    def _norm(self, x):
+        from ..ops.augment import normalize_image
+
+        if self.norm_stats is None:
+            return x.astype(jnp.float32)
+        return normalize_image(x, *self.norm_stats)
+
+    # -------------------- sBN recalibration --------------------
+
+    def _build_sbn(self):
+        model = self.model
+
+        def body(params, xb, wb):
+            # xb: [s_local, B, H, W, C] uint8; wb: [s_local, B]
+            def one(carry, inp):
+                x, w = inp
+                has = (jnp.sum(w) > 0).astype(jnp.float32)
+                _, col = model.apply(params, {"img": self._norm(x),
+                                              "label": jnp.zeros(x.shape[0], jnp.int32)},
+                                     train=True, bn_mode="collect", sample_weight=w)
+                sums = {site: (m * has, v * has) for site, (m, v) in col.items()}
+                carry_sums, carry_n = carry
+                carry_sums = {s: (carry_sums[s][0] + sums[s][0], carry_sums[s][1] + sums[s][1])
+                              for s in carry_sums}
+                return (carry_sums, carry_n + has), None
+
+            zero = {site: (jnp.zeros(model.meta["bn_sizes"][site]),
+                           jnp.zeros(model.meta["bn_sizes"][site]))
+                    for site in model.bn_sites}
+            (sums, n), _ = jax.lax.scan(one, (zero, jnp.zeros(())), (xb, wb))
+            sums = jax.lax.psum(sums, ("clients", "data"))
+            n = jax.lax.psum(n, ("clients", "data"))
+            return {s: (sums[s][0] / jnp.maximum(n, 1.0), sums[s][1] / jnp.maximum(n, 1.0))
+                    for s in sums}
+
+        fn = _shard_map(body, self.mesh,
+                        in_specs=(P(), P(("clients", "data")), P(("clients", "data"))),
+                        out_specs=P())
+        return jax.jit(fn)
+
+    def sbn_stats(self, params, x_batches: np.ndarray, w_batches: np.ndarray):
+        """Cumulative-average BN stats over ``[S, B, ...]`` uint8 batches.
+
+        S must be padded (zero-weight batches) to a multiple of the total
+        device count; returns ``{site: (running_mean, running_var)}``.
+        """
+        if not self.model.bn_sites:
+            return {}
+        if self._sbn is None:
+            self._sbn = self._build_sbn()
+        n_dev = self.mesh.devices.size
+        s = x_batches.shape[0]
+        pad = (-s) % n_dev
+        if pad:
+            x_batches = np.concatenate([x_batches, np.zeros((pad,) + x_batches.shape[1:],
+                                                            x_batches.dtype)])
+            w_batches = np.concatenate([w_batches, np.zeros((pad,) + w_batches.shape[1:],
+                                                            np.float32)])
+        return self._sbn(params, jnp.asarray(x_batches), jnp.asarray(w_batches))
+
+    # -------------------- evaluation --------------------
+
+    def _eval_batch_metrics(self, params, bn_state, batch, lm, w, key):
+        out, _ = self.model.apply(params, batch, train=False,
+                                  bn_mode="running" if bn_state else "batch",
+                                  bn_state=bn_state or None, label_mask=lm,
+                                  sample_weight=w, rng=key)
+        n = jnp.sum(w)
+        loss = out["loss"]
+        if self.is_lm:
+            # reference Perplexity is exp(batch CE), size-weighted by rows
+            rows = jnp.asarray(batch["label"].shape[0], jnp.float32)
+            return {"loss_sum": loss * rows, "score_sum": jnp.exp(loss) * rows, "n": rows}
+        y = batch["label"]
+        correct = jnp.sum((jnp.argmax(out["score"], -1) == y) * w)
+        return {"loss_sum": loss * n, "score_sum": correct, "n": n}
+
+    def _build_users(self):
+        model = self.model
+
+        def body(params, bn_state, key, valid, *data):
+            def one_user(x, y, m, lm, k, v):
+                # scan over the user's batches
+                def stepf(acc, inp):
+                    xb, yb, wb, kk = inp
+                    ms = self._eval_batch_metrics(params, bn_state,
+                                                  {"img": self._norm(xb), "label": yb},
+                                                  lm, wb, kk)
+                    return {kk2: acc[kk2] + ms[kk2] for kk2 in acc}, None
+
+                S = x.shape[0]
+                keys = jax.random.split(k, S)
+                acc0 = {"loss_sum": jnp.zeros(()), "score_sum": jnp.zeros(()), "n": jnp.zeros(())}
+                acc, _ = jax.lax.scan(stepf, acc0, (x, y, m, keys))
+                return {kk: v * acc[kk] for kk in acc}
+
+            x, y, m, lm = data
+            a = x.shape[0]
+            dev = jax.lax.axis_index("clients")
+            keys = jax.vmap(lambda i: jax.random.fold_in(key, dev * a + i))(jnp.arange(a))
+            return jax.vmap(one_user)(x, y, m, lm, keys, valid)
+
+        fn = _shard_map(body, self.mesh,
+                        in_specs=(P(), P(), P(), P("clients"), P("clients"), P("clients"),
+                                  P("clients"), P("clients")),
+                        out_specs=P("clients"))
+        return jax.jit(fn)
+
+    def eval_users(self, params, bn_state, x, y, m, lm):
+        """Per-user "Local" metrics: ``x [U, S, B, ...]`` batched test shards,
+        label masks ``lm [U, classes]``.  Returns per-user metric sums."""
+        if self._users is None:
+            self._users = self._build_users()
+        n_dev = self.mesh.shape["clients"]
+        u = x.shape[0]
+        pad = (-u) % n_dev
+        valid = np.concatenate([np.ones(u, np.float32), np.zeros(pad, np.float32)])
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+            y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+            m = np.concatenate([m, np.zeros((pad,) + m.shape[1:], np.float32)])
+            lm = np.concatenate([lm, np.zeros((pad,) + lm.shape[1:], np.float32)])
+        out = self._users(params, bn_state, jax.random.key(0), jnp.asarray(valid),
+                          jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+        return {k: np.asarray(v)[:u] for k, v in out.items()}
+
+    def _build_global(self):
+        def body(params, bn_state, key, *data):
+            if self.is_lm:
+                rows, w = data  # [s_local, R, bptt], [s_local, R, bptt]
+                def stepf(acc, inp):
+                    lab, wb, kk = inp
+                    ms = self._eval_batch_metrics(params, bn_state, {"label": lab},
+                                                  None, wb, kk)
+                    has = (jnp.sum(wb) > 0).astype(jnp.float32)
+                    return {k2: acc[k2] + ms[k2] * has for k2 in acc}, None
+                S = rows.shape[0]
+                keys = jax.random.split(key, S)
+                acc0 = {"loss_sum": jnp.zeros(()), "score_sum": jnp.zeros(()), "n": jnp.zeros(())}
+                acc, _ = jax.lax.scan(stepf, acc0, (rows, w, keys))
+            else:
+                x, y, w = data
+                def stepf(acc, inp):
+                    xb, yb, wb, kk = inp
+                    ms = self._eval_batch_metrics(params, bn_state,
+                                                  {"img": self._norm(xb), "label": yb},
+                                                  None, wb, kk)
+                    return {k2: acc[k2] + ms[k2] for k2 in acc}, None
+                S = x.shape[0]
+                keys = jax.random.split(key, S)
+                acc0 = {"loss_sum": jnp.zeros(()), "score_sum": jnp.zeros(()), "n": jnp.zeros(())}
+                acc, _ = jax.lax.scan(stepf, acc0, (x, y, w, keys))
+            return jax.lax.psum(acc, ("clients", "data"))
+
+        n_data = 3 if not self.is_lm else 2
+        fn = _shard_map(body, self.mesh,
+                        in_specs=(P(), P(), P()) + (P(("clients", "data")),) * n_data,
+                        out_specs=P())
+        return jax.jit(fn)
+
+    def eval_global(self, params, bn_state, *batched):
+        """"Global" metrics over the full test set: vision
+        ``(x [S,B,...], y [S,B], w [S,B])``; LM ``(rows [S,R,bptt], w)``."""
+        if self._global is None:
+            self._global = self._build_global()
+        n_dev = self.mesh.devices.size
+        s = batched[0].shape[0]
+        pad = (-s) % n_dev
+        padded = []
+        for arr in batched:
+            if pad:
+                arr = np.concatenate([arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
+            padded.append(jnp.asarray(arr))
+        out = self._global(params, bn_state, jax.random.key(1), *padded)
+        return {k: float(v) for k, v in out.items()}

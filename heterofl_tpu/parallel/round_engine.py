@@ -1,0 +1,273 @@
+"""The federated round engine: one XLA program per communication round.
+
+Replaces the reference's host-side round (sequential per-client training with
+deepcopy'd state_dicts, ref train_classifier_fed.py:99-124) with a single
+jitted ``shard_map`` over a ``clients`` mesh axis:
+
+  gather client shards -> vmap(local SGD over epochs x batches via lax.scan)
+  -> per-client count masks -> ``psum`` counted-average over ICI -> new global
+
+Width heterogeneity (5 rate levels) is runtime data (masks), so one compiled
+program serves every rate mix, including dynamic re-rolls (ref fed.py:15-19).
+All client datasets stay resident on device; a round moves no host data.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.7 new API
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+from ..data.datasets import DATASET_STATS
+from ..fed.core import combine_counted
+from ..models.base import ModelDef
+from ..models.spec import count_masks as make_count_masks, mask_params, param_mask
+from ..ops.augment import augment_cifar, normalize_image
+from ..utils.optim import clip_by_global_norm, make_optimizer
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class RoundEngine:
+    """Jitted train/eval/sBN programs for one (model, cfg, mesh) triple.
+
+    Shapes are taken from the arrays passed in; jit re-specialises on new
+    shapes automatically (in practice: one compile per experiment).
+    """
+
+    def __init__(self, model: ModelDef, cfg: Dict[str, Any], mesh: Optional[Mesh] = None):
+        self.model = model
+        self.cfg = cfg
+        self.mesh = mesh
+        self.global_rate = cfg["global_model_rate"]
+        ne = cfg["num_epochs"]
+        self.local_epochs = ne["local"] if isinstance(ne, dict) else 1
+        self.batch_size = cfg["batch_size"]["train"]
+        self.is_lm = model.meta.get("kind") == "transformer"
+        self.bptt = cfg.get("bptt", 64)
+        stats = DATASET_STATS.get(cfg["data_name"])
+        self.norm_stats = stats
+        self.augment = cfg["data_name"].startswith("CIFAR")
+        self.fix_rates = np.asarray(cfg["model_rate"], np.float32) \
+            if cfg["model_split_mode"] == "fix" else None
+        self._opt_init, self._opt_update = make_optimizer(cfg)
+        self._train = None
+        self._sbn = None
+        self._eval_users = None
+        self._eval_global = None
+
+    # ------------------------------------------------------------------
+    # per-client local training (pure; vmapped across clients)
+    # ------------------------------------------------------------------
+
+    def _prep_vision_batch(self, x_u8, w, key, train=True):
+        if self.augment and train:
+            x_u8 = augment_cifar(key, x_u8)
+        if self.norm_stats is not None:
+            img = normalize_image(x_u8, *self.norm_stats)
+        else:
+            img = x_u8.astype(jnp.float32)
+        return img
+
+    def _local_train_vision(self, params, wr, x, y, sm, lm, key, lr):
+        model, B, E = self.model, self.batch_size, self.local_epochs
+        N = x.shape[0]
+        S = _ceil_div(N, B)
+        SB = S * B
+        p = mask_params(params, model.specs, model.groups, wr)
+        opt = self._opt_init(p)
+        ekeys = jax.random.split(jax.random.fold_in(key, 1), E)
+        # Shuffle, then stable-sort the *real* samples (sm==1) to the front:
+        # batches are dense like the reference's DataLoader over the true
+        # shard, trailing all-padding batches carry zero weight and their
+        # optimizer step is skipped below -- exact ceil(sz/B) step parity
+        # for shards smaller than the stacked maximum.
+        def epoch_perm(k):
+            perm = jax.random.permutation(k, N)
+            order = jnp.argsort(-sm[perm], stable=True)
+            return perm[order]
+
+        perms = jax.vmap(epoch_perm)(ekeys)  # [E, N]
+        if SB > N:
+            reps = _ceil_div(SB, N)
+            perms = jnp.tile(perms, (1, reps))[:, :SB]
+            wpad = jnp.concatenate([jnp.ones(N, jnp.float32), jnp.zeros(SB - N, jnp.float32)])
+        else:
+            wpad = jnp.ones(SB, jnp.float32)
+
+        def step(carry, t):
+            p, opt, acc = carry
+            e, s = t // S, t % S
+            ids = jax.lax.dynamic_slice(perms, (e, s * B), (1, B))[0]
+            w = jax.lax.dynamic_slice(wpad, (s * B,), (B,)) * sm[ids]
+            img = self._prep_vision_batch(x[ids], w, jax.random.fold_in(key, 2 + t))
+            batch = {"img": img, "label": y[ids]}
+
+            def loss_fn(p):
+                out, _ = model.apply(p, batch, train=True, width_rate=wr, scaler_rate=wr,
+                                     label_mask=lm, sample_weight=w,
+                                     rng=jax.random.fold_in(key, 5000 + t))
+                return out["loss"], out["score"]
+
+            (loss, score), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            grads = {k: g * param_mask(g.shape, model.specs[k], model.groups, wr)
+                     for k, g in grads.items()}
+            grads, _ = clip_by_global_norm(grads, 1.0)
+            p_new, opt_new = self._opt_update(p, grads, opt, lr)
+            # all-padding batch: skip the step entirely (no wd/momentum drift)
+            has = (jnp.sum(w) > 0)
+            p = jax.tree_util.tree_map(lambda a, b: jnp.where(has, a, b), p_new, p)
+            opt = jax.tree_util.tree_map(lambda a, b: jnp.where(has, a, b), opt_new, opt)
+            n = jnp.sum(w)
+            correct = jnp.sum((jnp.argmax(score, -1) == y[ids]) * w)
+            acc = (acc[0] + loss * n, acc[1] + correct, acc[2] + n)
+            return (p, opt, acc), None
+
+        acc0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
+        (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S))
+        return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
+
+    def _local_train_lm(self, params, wr, rows, lm, key, lr):
+        model, E, bptt = self.model, self.local_epochs, self.bptt
+        R, T = rows.shape
+        S = _ceil_div(T, bptt)
+        pad = S * bptt - T
+        rows_p = jnp.pad(rows, ((0, 0), (0, pad)))
+        wpos = jnp.pad(jnp.ones((R, T), jnp.float32), ((0, 0), (0, pad)))
+        p = mask_params(params, model.specs, model.groups, wr)
+        opt = self._opt_init(p)
+
+        def step(carry, t):
+            p, opt, acc = carry
+            s = t % S
+            lab = jax.lax.dynamic_slice(rows_p, (0, s * bptt), (R, bptt))
+            w = jax.lax.dynamic_slice(wpos, (0, s * bptt), (R, bptt))
+
+            def loss_fn(p):
+                out, _ = model.apply(p, {"label": lab}, train=True, width_rate=wr,
+                                     scaler_rate=wr, label_mask=lm, sample_weight=w,
+                                     rng=jax.random.fold_in(key, 5000 + t))
+                return out["loss"]
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            grads = {k: g * param_mask(g.shape, model.specs[k], model.groups, wr)
+                     for k, g in grads.items()}
+            grads, _ = clip_by_global_norm(grads, 1.0)
+            p, opt = self._opt_update(p, grads, opt, lr)
+            # Logger weight: rows per window (ref train_transformer_fed.py
+            # appends with input['label'].size(0)); Perplexity = exp(window CE).
+            n = jnp.asarray(R, jnp.float32)
+            acc = (acc[0] + loss * n, acc[1] + jnp.exp(loss) * n, acc[2] + n)
+            return (p, opt, acc), None
+
+        acc0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
+        (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S))
+        return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
+
+    # ------------------------------------------------------------------
+    # the round program
+    # ------------------------------------------------------------------
+
+    def _build_train(self):
+        model, cfg = self.model, self.cfg
+        mesh = self.mesh
+        dynamic = cfg["model_split_mode"] == "dynamic"
+        num_users = cfg["num_users"]
+        n_dev = mesh.shape["clients"]
+
+        def body(params, key, lr, user_idx, *data):
+            # user_idx: this device's slot of active users, -1 = padding
+            a = user_idx.shape[0]
+            valid = (user_idx >= 0).astype(jnp.float32)
+            uidx = jnp.maximum(user_idx, 0)
+            if dynamic:
+                rates_all = jnp.asarray(cfg["model_rate"], jnp.float32)
+                ridx = jax.random.choice(jax.random.fold_in(key, 7), len(cfg["model_rate"]),
+                                         shape=(num_users,), p=jnp.asarray(cfg["proportion"]))
+                rates_abs = rates_all[ridx][uidx]
+            else:
+                rates_abs = data[-1][uidx]  # fix_rates passed as last data arg
+            wr = rates_abs / self.global_rate
+            dev = jax.lax.axis_index("clients")
+            slot_keys = jax.vmap(lambda i: jax.random.fold_in(key, dev * a + i + 13))(jnp.arange(a))
+
+            if self.is_lm:
+                all_rows, all_lm = data[0], data[1]
+                rows = all_rows[uidx]
+                lm = all_lm[uidx]
+                trained, ms = jax.vmap(
+                    lambda w_, r_, l_, k_: self._local_train_lm(params, w_, r_, l_, k_, lr)
+                )(wr, rows, lm, slot_keys)
+            else:
+                all_x, all_y, all_m, all_lm = data[0], data[1], data[2], data[3]
+                xs, ys, sms, lm = all_x[uidx], all_y[uidx], all_m[uidx], all_lm[uidx]
+                trained, ms = jax.vmap(
+                    lambda w_, x_, y_, m_, l_, k_: self._local_train_vision(
+                        params, w_, x_, y_, m_, l_, k_, lr)
+                )(wr, xs, ys, sms, lm, slot_keys)
+
+            shapes = {k: v.shape for k, v in params.items()}
+            cms = jax.vmap(lambda w_, l_, v_: jax.tree_util.tree_map(
+                lambda m: m * v_, make_count_masks(shapes, model.specs, model.groups, w_, l_)))(
+                wr, lm, valid)
+            summed = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in params}
+            counts = {k: jnp.sum(cms[k], axis=0) for k in params}
+            summed = jax.lax.psum(summed, "clients")
+            counts = jax.lax.psum(counts, "clients")
+            new_params = combine_counted(params, summed, counts)
+            ms = {k: v * valid for k, v in ms.items()}
+            ms["rate"] = rates_abs * valid
+            return new_params, ms
+
+        if self.is_lm:
+            data_specs = (P(), P())
+        else:
+            data_specs = (P(), P(), P(), P())
+        if self.fix_rates is not None:
+            data_specs = data_specs + (P(),)
+        fn = _shard_map(
+            body, mesh,
+            in_specs=(P(), P(), P(), P("clients")) + data_specs,
+            out_specs=(P(), P("clients")),
+        )
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def train_round(self, params, key, lr, user_idx, data: Tuple[jnp.ndarray, ...]):
+        """Run one communication round.
+
+        ``user_idx``: int32 [A] active user ids, padded with -1 to a multiple
+        of the clients-axis size.  ``data``: for vision
+        ``(all_x[U,N,H,W,C] uint8, all_y[U,N], all_m[U,N], all_lm[U,classes])``;
+        for LM ``(all_rows[U,R,T], all_lm[U,vocab])``.  Returns
+        ``(new_params, per-client metric sums)``.
+        """
+        if self._train is None:
+            self._train = self._build_train()
+        n_dev = self.mesh.shape["clients"]
+        a = len(user_idx)
+        pad = (-a) % n_dev
+        user_idx = np.concatenate([np.asarray(user_idx, np.int32), -np.ones(pad, np.int32)])
+        args = tuple(data)
+        if self.fix_rates is not None:
+            args = args + (self.fix_rates,)
+        lr = jnp.asarray(lr, jnp.float32)
+        return self._train(params, key, lr, jnp.asarray(user_idx), *args)

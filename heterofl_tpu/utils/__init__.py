@@ -1,0 +1,10 @@
+from .checkpoint import (  # noqa: F401
+    checkpoint_path,
+    copy_best,
+    load_checkpoint,
+    resume,
+    save_checkpoint,
+)
+from .logger import Logger  # noqa: F401
+from .metrics import Metric, accuracy, perplexity, summarize_sums  # noqa: F401
+from .optim import clip_by_global_norm, make_optimizer, make_scheduler  # noqa: F401

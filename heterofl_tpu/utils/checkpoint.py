@@ -1,0 +1,77 @@
+"""Checkpoint / resume.
+
+Parity: ``src/utils.py:300-344`` + the per-round save in
+``train_classifier_fed.py:84-93``: each round stores
+``{cfg, epoch, data_split, label_split, params, bn_state, scheduler_state,
+logger history}`` to ``output/model/{tag}_checkpoint.pkl`` with a best-pivot
+copy to ``_best.pkl``; resume restores everything *including the data
+partition* so a resumed run keeps identical client shards.
+
+``resume_mode``: 0 fresh / 1 full resume / 2 weights+splits only
+(ref train_classifier_fed.py:57-69).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_host(tree):
+    if isinstance(tree, dict):
+        return {k: _to_host(v) for k, v in tree.items()}
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # NamedTuple
+        return type(tree)(*(_to_host(v) for v in tree))
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_to_host(v) for v in tree)
+    if isinstance(tree, (jnp.ndarray, np.ndarray)):
+        return np.asarray(tree)
+    return tree
+
+
+def save_checkpoint(path: str, blob: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(_to_host(blob), f, protocol=4)
+    os.replace(tmp, path)  # atomic: a crash never corrupts the previous ckpt
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def checkpoint_path(output_dir: str, tag: str, which: str = "checkpoint") -> str:
+    return os.path.join(output_dir, "model", f"{tag}_{which}.pkl")
+
+
+def copy_best(output_dir: str, tag: str) -> None:
+    shutil.copy(checkpoint_path(output_dir, tag, "checkpoint"),
+                checkpoint_path(output_dir, tag, "best"))
+
+
+def resume(output_dir: str, tag: str, mode: int, load_tag: str = "checkpoint"
+           ) -> Optional[Dict[str, Any]]:
+    """Return the checkpoint blob according to ``resume_mode`` or None.
+
+    mode 0 -> always fresh; mode 1 -> full blob; mode 2 -> weights + splits
+    only (epoch restarts at 1, fresh logger/scheduler).
+    """
+    if mode == 0:
+        return None
+    path = checkpoint_path(output_dir, tag, load_tag)
+    if not os.path.exists(path):
+        print(f"Not exists model tag: {tag}, start from scratch")
+        return None
+    blob = load_checkpoint(path)
+    print(f"Resume from {blob.get('epoch')}")
+    if mode == 2:
+        return {k: blob[k] for k in ("params", "bn_state", "data_split", "label_split")
+                if k in blob}
+    return blob

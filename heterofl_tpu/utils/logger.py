@@ -1,0 +1,94 @@
+"""Experiment logger: size-weighted running means, per-round history, and
+pluggable writers (JSONL always; TensorBoard if available).
+
+Parity: ``src/logger.py`` -- ``append(result, tag, n)`` updates running means
+keyed ``{tag}/{metric}``; ``safe(True/False)`` opens/closes a writer and
+snapshots means into ``history``; ``write`` emits one info line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from numbers import Number
+from typing import Dict, Iterable, List, Optional
+
+
+class Logger:
+    def __init__(self, log_path: str, use_tensorboard: bool = False):
+        self.log_path = log_path
+        self.use_tensorboard = use_tensorboard
+        self.writer = None
+        self._jsonl = None
+        self.tracker: Dict[str, object] = {}
+        self.counter: Dict[str, float] = defaultdict(float)
+        self.mean: Dict[str, float] = defaultdict(float)
+        self.history: Dict[str, List[float]] = defaultdict(list)
+        self.iterator: Dict[str, int] = defaultdict(int)
+
+    # -- lifecycle ----------------------------------------------------
+    def safe(self, write: bool) -> None:
+        if write:
+            os.makedirs(self.log_path, exist_ok=True)
+            self._jsonl = open(os.path.join(self.log_path, "log.jsonl"), "a")
+            if self.use_tensorboard and self.writer is None:
+                try:
+                    from torch.utils.tensorboard import SummaryWriter
+
+                    self.writer = SummaryWriter(self.log_path)
+                except Exception:
+                    self.writer = None
+        else:
+            if self.writer is not None:
+                self.writer.close()
+                self.writer = None
+            if self._jsonl is not None:
+                self._jsonl.close()
+                self._jsonl = None
+            for name in self.mean:
+                self.history[name].append(self.mean[name])
+
+    def reset(self) -> None:
+        self.tracker = {}
+        self.counter = defaultdict(float)
+        self.mean = defaultdict(float)
+
+    # -- accumulation -------------------------------------------------
+    def append(self, result: Dict[str, object], tag: str, n: float = 1, mean: bool = True) -> None:
+        for k, v in result.items():
+            name = f"{tag}/{k}"
+            self.tracker[name] = v
+            if mean and isinstance(v, Number):
+                self.counter[name] += n
+                c = self.counter[name]
+                self.mean[name] = ((c - n) * self.mean[name] + n * float(v)) / c
+
+    # -- output -------------------------------------------------------
+    def write(self, tag: str, metric_names: Iterable[str]) -> str:
+        parts = []
+        record = {"tag": tag, "t": time.time()}
+        for k in metric_names:
+            name = f"{tag}/{k}"
+            if name in self.mean:
+                parts.append(f"{k}: {self.mean[name]:.4f}")
+                record[k] = self.mean[name]
+                if self.writer is not None:
+                    self.iterator[name] += 1
+                    self.writer.add_scalar(name, self.mean[name], self.iterator[name])
+        info = self.tracker.get(f"{tag}/info")
+        line_items = list(info) if isinstance(info, list) else ([str(info)] if info else [])
+        line_items[2:2] = parts
+        line = "  ".join(line_items) if line_items else "  ".join(parts)
+        print(line)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(record) + "\n")
+            self._jsonl.flush()
+        return line
+
+    def flush(self) -> None:
+        if self.writer is not None:
+            self.writer.flush()
+        if self._jsonl is not None:
+            self._jsonl.flush()

@@ -1,0 +1,164 @@
+"""Optimizers and LR schedules.
+
+Parity: ``src/utils.py:260-297``.  Optimizers are pure ``(init, update)``
+pairs over param pytrees so they vmap across clients and live inside
+``lax.scan``; torch semantics are matched exactly for SGD (the one the
+federated configs use: momentum + weight decay applied to the gradient,
+``p -= lr * buf``) and closely for RMSprop/Adam/Adamax.
+
+Schedules are pure ``step -> lr`` functions evaluated on the host once per
+round (the reference steps a torch scheduler on the *global* optimizer purely
+to derive the lr handed to each client's fresh local optimizer,
+ref train_classifier_fed.py:104).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def clip_by_global_norm(grads, max_norm: float = 1.0):
+    """torch.nn.utils.clip_grad_norm_ parity (ref train_classifier_fed.py:205)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    total = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads), total
+
+
+class OptState(NamedTuple):
+    step: jnp.ndarray
+    slots: Any  # optimizer-specific pytree(s)
+
+
+def make_optimizer(cfg: Dict[str, Any]):
+    """Return ``(init(params) -> state, update(params, grads, state, lr) ->
+    (new_params, new_state))`` for ``cfg['optimizer_name']``."""
+    name = cfg["optimizer_name"]
+    momentum = cfg.get("momentum", 0.0)
+    wd = cfg.get("weight_decay", 0.0)
+
+    if name == "SGD":
+        def init(params):
+            return OptState(jnp.zeros((), jnp.int32),
+                            jax.tree_util.tree_map(jnp.zeros_like, params))
+
+        def update(params, grads, state, lr):
+            new_b = jax.tree_util.tree_map(lambda p, g, b: momentum * b + g + wd * p,
+                                           params, grads, state.slots)
+            new_p = jax.tree_util.tree_map(lambda p, b: p - lr * b, params, new_b)
+            return new_p, OptState(state.step + 1, new_b)
+
+        return init, update
+
+    if name == "RMSprop":
+        alpha, eps = 0.99, 1e-8
+
+        def init(params):
+            z = jax.tree_util.tree_map(jnp.zeros_like, params)
+            return OptState(jnp.zeros((), jnp.int32), {"sq": z, "buf": z})
+
+        def update(params, grads, state, lr):
+            # torch: grad = grad + wd*p, applied before square accumulation
+            g2 = jax.tree_util.tree_map(lambda g, p: g + wd * p, grads, params)
+            sq = jax.tree_util.tree_map(lambda s, g: alpha * s + (1 - alpha) * g * g,
+                                        state.slots["sq"], g2)
+            buf = jax.tree_util.tree_map(lambda b, g, s: momentum * b + g / (jnp.sqrt(s) + eps),
+                                         state.slots["buf"], g2, sq)
+            new_p = jax.tree_util.tree_map(lambda p, b: p - lr * b, params, buf)
+            return new_p, OptState(state.step + 1, {"sq": sq, "buf": buf})
+
+        return init, update
+
+    if name in ("Adam", "Adamax"):
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def init(params):
+            z = jax.tree_util.tree_map(jnp.zeros_like, params)
+            return OptState(jnp.zeros((), jnp.int32), {"m": z, "v": z})
+
+        def update(params, grads, state, lr):
+            t = state.step + 1
+            g2 = jax.tree_util.tree_map(lambda g, p: g + wd * p, grads, params)
+            m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state.slots["m"], g2)
+            if name == "Adam":
+                v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state.slots["v"], g2)
+                denom = jax.tree_util.tree_map(
+                    lambda v_: jnp.sqrt(v_ / (1 - b2 ** t.astype(jnp.float32))) + eps, v)
+            else:  # Adamax: infinity norm
+                v = jax.tree_util.tree_map(lambda v_, g: jnp.maximum(b2 * v_, jnp.abs(g) + eps),
+                                           state.slots["v"], g2)
+                denom = v
+            mhat = jax.tree_util.tree_map(lambda m_: m_ / (1 - b1 ** t.astype(jnp.float32)), m)
+            new_p = jax.tree_util.tree_map(lambda p, mh, d: p - lr * mh / d, params, mhat, denom)
+            return new_p, OptState(t, {"m": m, "v": v})
+
+        return init, update
+
+    raise ValueError("Not valid optimizer name")
+
+
+def make_scheduler(cfg: Dict[str, Any]) -> Callable[[int], float]:
+    """LR as a pure function of the (1-indexed) global round.
+
+    Kinds mirror src/utils.py:276-297: None, StepLR, MultiStepLR,
+    ExponentialLR, CosineAnnealingLR, CyclicLR, ReduceLROnPlateau (the last
+    needs a metric feed; see :class:`PlateauScheduler`).
+    """
+    name = cfg["scheduler_name"]
+    base = cfg["lr"]
+    factor = cfg.get("factor", 0.1)
+    if name == "None":
+        return lambda step: base
+    if name == "StepLR":
+        size = cfg["step_size"]
+        return lambda step: base * factor ** ((step - 1) // size)
+    if name == "MultiStepLR":
+        miles = sorted(cfg["milestones"])
+        return lambda step: base * factor ** sum(1 for m in miles if step - 1 >= m)
+    if name == "ExponentialLR":
+        return lambda step: base * 0.99 ** (step - 1)
+    if name == "CosineAnnealingLR":
+        tmax = cfg["num_epochs"]["global"] if isinstance(cfg["num_epochs"], dict) else cfg["num_epochs"]
+        eta_min = cfg.get("min_lr", 0.0)
+        return lambda step: eta_min + (base - eta_min) * (1 + math.cos(math.pi * (step - 1) / tmax)) / 2
+    if name == "CyclicLR":
+        # torch defaults: step_size_up=2000 iters, triangular
+        up = 2000
+        return lambda step: base + (10 * base - base) * _triangle((step - 1) / up)
+    if name == "ReduceLROnPlateau":
+        return PlateauScheduler(base, factor, cfg.get("patience", 10),
+                                cfg.get("threshold", 1e-3), cfg.get("min_lr", 0.0))
+    raise ValueError("Not valid scheduler name")
+
+
+def _triangle(x: float) -> float:
+    cycle = math.floor(1 + x / 2)
+    xx = abs(x / 1 - 2 * cycle + 1)
+    return max(0.0, 1 - xx)
+
+
+class PlateauScheduler:
+    """min-mode ReduceLROnPlateau with relative threshold (torch parity)."""
+
+    def __init__(self, base: float, factor: float, patience: int, threshold: float, min_lr: float):
+        self.lr = base
+        self.factor, self.patience, self.threshold, self.min_lr = factor, patience, threshold, min_lr
+        self.best = float("inf")
+        self.bad = 0
+
+    def __call__(self, step: int) -> float:
+        return self.lr
+
+    def step_metric(self, metric: float) -> None:
+        if metric < self.best * (1 - self.threshold):
+            self.best = metric
+            self.bad = 0
+        else:
+            self.bad += 1
+            if self.bad > self.patience:
+                self.lr = max(self.lr * self.factor, self.min_lr)
+                self.bad = 0

@@ -1,0 +1,125 @@
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heterofl_tpu.utils import (
+    Logger,
+    Metric,
+    accuracy,
+    checkpoint_path,
+    copy_best,
+    load_checkpoint,
+    make_optimizer,
+    make_scheduler,
+    perplexity,
+    resume,
+    save_checkpoint,
+    summarize_sums,
+)
+
+
+def test_accuracy_and_perplexity():
+    score = np.array([[2.0, 1.0, 0.0], [0.0, 3.0, 1.0]])
+    assert accuracy(score, np.array([0, 1])) == 100.0
+    assert accuracy(score, np.array([1, 1])) == 50.0
+    p = perplexity(np.zeros((2, 4)), np.array([0, 1]))
+    assert abs(p - 4.0) < 1e-6  # uniform logits over 4 classes
+
+
+def test_metric_registry():
+    m = Metric()
+    out = {"loss": jnp.asarray(1.5), "score": np.array([[5.0, 0.0]])}
+    ev = m.evaluate(["Local-Loss", "Local-Accuracy"], {"label": np.array([0])}, out)
+    assert ev == {"Local-Loss": 1.5, "Local-Accuracy": 100.0}
+
+
+def test_summarize_sums():
+    s = {"loss_sum": np.array([2.0, 4.0]), "score_sum": np.array([1.0, 2.0]), "n": np.array([2.0, 2.0])}
+    out = summarize_sums(s, "conv")
+    assert out["Local-Loss"] == 1.5
+    assert out["Local-Accuracy"] == 75.0
+    lm = summarize_sums(s, "transformer", prefix="Global-")
+    assert abs(lm["Global-Perplexity"] - 0.75) < 1e-9
+
+
+def test_logger_weighted_mean_and_history(tmp_path):
+    lg = Logger(str(tmp_path / "run"))
+    lg.safe(True)
+    lg.append({"Loss": 2.0}, "train", n=10)
+    lg.append({"Loss": 1.0}, "train", n=30)
+    assert abs(lg.mean["train/Loss"] - 1.25) < 1e-9
+    lg.append({"info": ["Model: x", "Epoch: 1"]}, "train", mean=False)
+    line = lg.write("train", ["Loss"])
+    assert "Loss: 1.2500" in line
+    lg.safe(False)
+    assert lg.history["train/Loss"] == [1.25]
+    lg.reset()
+    assert lg.mean == {}
+    assert os.path.exists(tmp_path / "run" / "log.jsonl")
+
+
+def test_checkpoint_roundtrip_and_modes(tmp_path):
+    out = str(tmp_path)
+    blob = {
+        "cfg": {"a": 1},
+        "epoch": 7,
+        "params": {"w": jnp.ones((2, 2))},
+        "bn_state": {},
+        "data_split": {"train": {0: [1, 2]}},
+        "label_split": {0: [1]},
+        "scheduler_state": None,
+        "logger_history": {"test/Global-Accuracy": [50.0]},
+    }
+    save_checkpoint(checkpoint_path(out, "tag"), blob)
+    copy_best(out, "tag")
+    full = resume(out, "tag", mode=1)
+    assert full["epoch"] == 7
+    assert isinstance(full["params"]["w"], np.ndarray)
+    part = resume(out, "tag", mode=2)
+    assert set(part) == {"params", "bn_state", "data_split", "label_split"}
+    assert resume(out, "tag", mode=0) is None
+    assert resume(out, "missing", mode=1) is None
+    best = load_checkpoint(checkpoint_path(out, "tag", "best"))
+    assert best["epoch"] == 7
+
+
+def test_schedulers():
+    cfg = {"scheduler_name": "MultiStepLR", "lr": 0.1, "factor": 0.1,
+           "milestones": [2, 4], "num_epochs": {"global": 10}}
+    s = make_scheduler(cfg)
+    assert [round(s(i), 4) for i in (1, 2, 3, 4, 5)] == [0.1, 0.1, 0.01, 0.01, 0.001]
+    cfg["scheduler_name"] = "None"
+    assert make_scheduler(cfg)(99) == 0.1
+    cfg["scheduler_name"] = "ExponentialLR"
+    assert abs(make_scheduler(cfg)(2) - 0.099) < 1e-9
+    cfg["scheduler_name"] = "CosineAnnealingLR"
+    cfg["min_lr"] = 0.0
+    sc = make_scheduler(cfg)
+    assert abs(sc(1) - 0.1) < 1e-9 and sc(11) < 1e-9
+    cfg["scheduler_name"] = "ReduceLROnPlateau"
+    cfg["patience"] = 1
+    cfg["threshold"] = 1e-3
+    pl = make_scheduler(cfg)
+    for _ in range(5):
+        pl.step_metric(1.0)
+    assert pl(1) < 0.1
+
+
+def test_optimizer_sgd_matches_torch():
+    import torch
+
+    w0 = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    g = np.random.default_rng(1).normal(size=(4, 3)).astype(np.float32)
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    opt = torch.optim.SGD([tw], lr=0.1, momentum=0.9, weight_decay=5e-4)
+    cfg = {"optimizer_name": "SGD", "momentum": 0.9, "weight_decay": 5e-4}
+    init, update = make_optimizer(cfg)
+    p = {"w": jnp.asarray(w0)}
+    st = init(p)
+    for _ in range(3):
+        tw.grad = torch.tensor(g.copy())
+        opt.step()
+        p, st = update(p, {"w": jnp.asarray(g)}, st, 0.1)
+    np.testing.assert_allclose(np.asarray(p["w"]), tw.detach().numpy(), rtol=1e-5, atol=1e-6)
