@@ -1,0 +1,3 @@
+"""Post-hoc analysis tooling: model profiler, experiment grid generator,
+result aggregation/plots (the reference's ``summary.py`` / ``make.py`` /
+``process.py`` layer)."""

@@ -1,0 +1,149 @@
+"""Experiment grid generator.
+
+Parity: ``src/make.py`` / ``src/make_ablation.py`` -- build the cartesian
+product of control strings (singles a1..e1, dynamic multi-level combinations,
+9-step two-level interpolation pairs; ablation grids over norm/scale/mask)
+and emit a bash script of entry-point invocations with ``wait`` barriers
+every ``--round`` jobs (ref make.py:88-98).
+
+TPU flavour: instead of round-robining ``CUDA_VISIBLE_DEVICES`` (ref
+make.py:31), jobs are grouped into waves that each own the host's TPU; an
+optional ``--hosts`` list round-robins jobs across machines via a
+``HOST=<name>`` env prefix your launcher can interpret.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+from typing import Dict, List
+
+LEVELS = ["a", "b", "c", "d", "e"]
+
+
+def single_modes(levels: List[str] = LEVELS) -> List[str]:
+    return [x + "1" for x in levels]
+
+
+def combination_modes(levels: List[str] = LEVELS) -> List[str]:
+    """All >=2-level equal-proportion combinations (ref make.py:57-61)."""
+    singles = single_modes(levels)
+    out: List[str] = []
+    for i in range(1, len(singles) + 1):
+        out.extend("-".join(x) for x in itertools.combinations(singles, i))
+    return out[len(singles):]
+
+
+def interp_modes(levels: List[str] = LEVELS) -> List[str]:
+    """Two-level proportion sweeps xi-y(10-i), i=1..9 (ref make.py:62-66)."""
+    out = []
+    for i in range(1, 10):
+        for j in range(len(levels)):
+            for k in range(j + 1, len(levels)):
+                out.append(f"{levels[j]}{i}-{levels[k]}{10 - i}")
+    return out
+
+
+MODEL_TABLE = {
+    "conv": ("MNIST", "classifier"),
+    "resnet18": ("CIFAR10", "classifier"),
+    "transformer": ("WikiText2", "transformer"),
+}
+
+
+def build_controls(model: str, fed: int, data_split_mode: str, ablation: bool = False
+                   ) -> List[str]:
+    """Control strings for one model family (ref make.py:67-82 and
+    make_ablation.py:55-85)."""
+    if ablation:
+        levels = ["a", "e"]
+        combo = combination_modes(levels)
+        norm_1, norm_2 = ["bn", "none"], ["in", "ln", "gn"]
+        if data_split_mode == "iid":
+            blocks = [
+                [["1"], ["100"], ["0.1"], [data_split_mode], ["fix"], single_modes(levels),
+                 norm_2 + norm_1, ["1"], ["1"]],
+                [["1"], ["100"], ["0.1"], [data_split_mode], ["dynamic"], combo, norm_2, ["1"], ["1"]],
+                [["1"], ["100"], ["0.1"], [data_split_mode], ["dynamic"], combo, norm_1, ["0", "1"], ["1"]],
+            ]
+        else:
+            blocks = [
+                [["1"], ["100"], ["0.1"], [data_split_mode], ["fix"], single_modes(levels), norm_2, ["1"], ["1"]],
+                [["1"], ["100"], ["0.1"], [data_split_mode], ["fix"], single_modes(levels), norm_1, ["1"], ["0", "1"]],
+                [["1"], ["100"], ["0.1"], [data_split_mode], ["dynamic"], combo, norm_2, ["1"], ["1"]],
+            ]
+    elif fed == 0:
+        blocks = [[["0"], ["1"], ["1"], [data_split_mode], ["fix"], single_modes(), ["bn"], ["1"], ["1"]]]
+    else:
+        blocks = [
+            [["1"], ["100"], ["0.1"], [data_split_mode], ["fix"], single_modes(), ["bn"], ["1"], ["1"]],
+            [["1"], ["100"], ["0.1"], [data_split_mode], ["dynamic"], combination_modes(), ["bn"], ["1"], ["1"]],
+            [["1"], ["100"], ["0.1"], [data_split_mode], ["fix"], interp_modes(), ["bn"], ["1"], ["1"]],
+        ]
+    out: List[str] = []
+    for b in blocks:
+        out.extend("_".join(x) for x in itertools.product(*b))
+    return out
+
+
+def make_script(run: str, model: str, fed: int, data_split_mode: str, *,
+                init_seed: int = 0, num_experiments: int = 1, experiment_step: int = 1,
+                resume_mode: int = 0, round_size: int = 1, hosts: List[str] = (),
+                ablation: bool = False, synthetic: bool = False) -> str:
+    data_name, family = MODEL_TABLE[model]
+    suffix = "_fed" if fed == 1 else ""
+    module = f"heterofl_tpu.entry.{run}_{family}{suffix}"
+    controls = build_controls(model, fed, data_split_mode if fed else "none", ablation)
+    seeds = list(range(init_seed, init_seed + num_experiments, experiment_step))
+    lines = ["#!/bin/bash"]
+    k = 0
+    extra = " --synthetic 1" if synthetic else ""
+    for seed in seeds:
+        for ctl in controls:
+            prefix = f"HOST={hosts[k % len(hosts)]} " if hosts else ""
+            lines.append(
+                f"{prefix}python -m {module} --data_name {data_name} --model_name {model} "
+                f"--init_seed {seed} --num_experiments {experiment_step} "
+                f"--resume_mode {resume_mode} --control_name {ctl}{extra} &")
+            if k % round_size == round_size - 1:
+                lines[-1] = lines[-1][:-2]
+                lines.append("wait")
+            k += 1
+    if lines[-1] != "wait":
+        lines.append("wait")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="experiment grid generator")
+    parser.add_argument("--run", default="train", type=str)
+    parser.add_argument("--model", default="resnet18", type=str)
+    parser.add_argument("--fed", default=1, type=int)
+    parser.add_argument("--init_seed", default=0, type=int)
+    parser.add_argument("--round", default=1, type=int)
+    parser.add_argument("--experiment_step", default=1, type=int)
+    parser.add_argument("--num_experiments", default=1, type=int)
+    parser.add_argument("--resume_mode", default=0, type=int)
+    parser.add_argument("--data_split_mode", default="iid", type=str)
+    parser.add_argument("--hosts", default="", type=str, help="comma-separated host list")
+    parser.add_argument("--ablation", action="store_true")
+    parser.add_argument("--synthetic", action="store_true")
+    args = parser.parse_args(argv)
+    s = make_script(args.run, args.model, args.fed, args.data_split_mode,
+                    init_seed=args.init_seed, num_experiments=args.num_experiments,
+                    experiment_step=args.experiment_step, resume_mode=args.resume_mode,
+                    round_size=args.round, hosts=[h for h in args.hosts.split(",") if h],
+                    ablation=args.ablation, synthetic=args.synthetic)
+    name = f"{args.run}_{args.model}_{args.data_split_mode if args.fed else 'none'}"
+    if args.ablation:
+        name += "_ablation"
+    path = f"./{name}.sh"
+    with open(path, "w") as f:
+        f.write(s)
+    print(s)
+    print(f"# written to {path}")
+    return s
+
+
+if __name__ == "__main__":
+    main()

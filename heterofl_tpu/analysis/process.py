@@ -1,0 +1,230 @@
+"""Result aggregation and plotting.
+
+Parity: ``src/process.py`` -- load ``output/result/*.pkl`` bundles produced
+by the ``test_*`` entry points, nest/aggregate mean/std across seeds
+(process.py:114-179), export a table (csv always, xlsx via pandas when
+available, mirroring process.py:196-230), and render learning curves and the
+accuracy-vs-params interpolation figures (process.py:233-342) using the
+profiler bundles from :mod:`heterofl_tpu.analysis.summary`
+(process.py:345-374).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import config as C
+
+METRIC_KEYS = ("Global-Accuracy", "Global-Perplexity", "Global-Loss",
+               "Local-Accuracy", "Local-Perplexity", "Local-Loss",
+               "Accuracy", "Perplexity", "Loss")
+
+
+def parse_tag(tag: str) -> Optional[Dict[str, str]]:
+    """Invert ``make_model_tag``: seed_data_subset_model_<9 control fields>."""
+    parts = tag.split("_")
+    if len(parts) < 4 + len(C.CONTROL_KEYS):
+        return None
+    ctl = dict(zip(C.CONTROL_KEYS, parts[-len(C.CONTROL_KEYS):]))
+    head = parts[: -len(C.CONTROL_KEYS)]
+    return {"seed": head[0], "data_name": head[1],
+            "subset": head[2] if len(head) > 3 else "",
+            "model_name": head[-1], **ctl}
+
+
+def load_results(output_dir: str) -> List[Dict[str, Any]]:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(output_dir, "result", "*.pkl"))):
+        tag = os.path.splitext(os.path.basename(path))[0]
+        meta = parse_tag(tag)
+        if meta is None:
+            continue
+        with open(path, "rb") as f:
+            bundle = pickle.load(f)
+        metrics: Dict[str, float] = {}
+        hist = bundle.get("logger_history", {})
+        for k in METRIC_KEYS:
+            if f"test/{k}" in hist and hist[f"test/{k}"]:
+                metrics[k] = float(hist[f"test/{k}"][-1])
+        metrics.update({k: float(v) for k, v in bundle.get("metrics", {}).items()})
+        rows.append({"tag": tag, **meta, "metrics": metrics,
+                     "train_history": bundle.get("train_history", {})})
+    return rows
+
+
+def aggregate(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Group by everything except seed; mean/std across seeds
+    (ref process.py:114-179)."""
+    groups: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    for r in rows:
+        key = "_".join([r["data_name"], r["subset"], r["model_name"]]
+                       + [r[k] for k in C.CONTROL_KEYS])
+        groups[key].append(r)
+    out = {}
+    for key, rs in groups.items():
+        metrics = defaultdict(list)
+        for r in rs:
+            for k, v in r["metrics"].items():
+                metrics[k].append(v)
+        out[key] = {
+            "n_seeds": len(rs),
+            "mean": {k: float(np.mean(v)) for k, v in metrics.items()},
+            "std": {k: float(np.std(v)) for k, v in metrics.items()},
+            "rows": rs,
+        }
+    return out
+
+
+def export_table(agg: Dict[str, Dict[str, Any]], output_dir: str,
+                 name: str = "result") -> str:
+    """csv always; xlsx too when pandas+openpyxl are importable."""
+    all_metrics = sorted({m for g in agg.values() for m in g["mean"]})
+    header = ["experiment", "n_seeds"] + [f"{m}_mean" for m in all_metrics] \
+        + [f"{m}_std" for m in all_metrics]
+    lines = [",".join(header)]
+    for key in sorted(agg):
+        g = agg[key]
+        row = [key, str(g["n_seeds"])]
+        row += [f"{g['mean'].get(m, float('nan')):.6g}" for m in all_metrics]
+        row += [f"{g['std'].get(m, float('nan')):.6g}" for m in all_metrics]
+        lines.append(",".join(row))
+    os.makedirs(output_dir, exist_ok=True)
+    csv_path = os.path.join(output_dir, f"{name}.csv")
+    with open(csv_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    try:
+        import pandas as pd
+
+        df = pd.read_csv(csv_path)
+        df.to_excel(os.path.join(output_dir, f"{name}.xlsx"), index=False)
+    except Exception:
+        pass
+    return csv_path
+
+
+def _plt():
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        return plt
+    except Exception:
+        return None
+
+
+def make_learning_curves(rows: List[Dict[str, Any]], output_dir: str,
+                         metric: str = "Global-Accuracy") -> List[str]:
+    """Per-experiment learning curves (ref process.py:300-342)."""
+    plt = _plt()
+    if plt is None:
+        return []
+    paths = []
+    fig_dir = os.path.join(output_dir, "fig")
+    os.makedirs(fig_dir, exist_ok=True)
+    for r in rows:
+        hist = r.get("train_history", {})
+        series = hist.get(f"test/{metric}")
+        if not series:
+            continue
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.plot(range(1, len(series) + 1), series)
+        ax.set_xlabel("communication round")
+        ax.set_ylabel(metric)
+        ax.set_title(r["tag"], fontsize=8)
+        ax.grid(True, alpha=0.3)
+        p = os.path.join(fig_dir, f"lc_{r['tag']}.png")
+        fig.savefig(p, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        paths.append(p)
+    return paths
+
+
+def make_interpolation_plot(agg: Dict[str, Dict[str, Any]], output_dir: str,
+                            metric: str = "Global-Accuracy") -> Optional[str]:
+    """Accuracy vs model-size ratio across model modes (ref process.py:233-299).
+
+    The x position of a mode like ``a1-b9`` is its expected params ratio
+    computed from the profiler bundles (``{data}_{model}_{mode}.pkl``,
+    ref process.py:345-374); falls back to the width-rate-squared heuristic
+    when profiles are absent.
+    """
+    plt = _plt()
+    if plt is None or not agg:
+        return None
+
+    def mode_ratio(data_name, model_name, model_mode):
+        parts = [(p[0], int(p[1:])) for p in model_mode.split("-")]
+        # Use profiler bundles only if EVERY needed level (incl. the 'a'
+        # normaliser) has one; otherwise fall back to the width-rate-squared
+        # heuristic for ALL levels -- never mix the two unit systems.
+        def load_params(level):
+            path = os.path.join(output_dir, "result", f"{data_name}_{model_name}_{level}.pkl")
+            if not os.path.exists(path):
+                return None
+            with open(path, "rb") as f:
+                return pickle.load(f)["num_params"]
+
+        needed = sorted({lvl for lvl, _ in parts} | {"a"})
+        profiled = {lvl: load_params(lvl) for lvl in needed}
+        if all(v is not None for v in profiled.values()):
+            sizes = [profiled[lvl] / profiled["a"] for lvl, _ in parts]
+        else:
+            sizes = [C.MODEL_SPLIT_RATE[lvl] ** 2 for lvl, _ in parts]
+        w = np.array([prop for _, prop in parts], np.float64)
+        w = w / w.sum()
+        return float(np.dot(w, np.array(sizes)))
+
+    fig, ax = plt.subplots(figsize=(6, 4))
+    xs, ys, labels = [], [], []
+    for key in sorted(agg):
+        g = agg[key]
+        r0 = g["rows"][0]
+        if metric not in g["mean"]:
+            continue
+        xs.append(mode_ratio(r0["data_name"], r0["model_name"], r0["model_mode"]))
+        ys.append(g["mean"][metric])
+        labels.append(r0["model_mode"])
+    if not xs:
+        plt.close(fig)
+        return None
+    order = np.argsort(xs)
+    ax.plot(np.array(xs)[order], np.array(ys)[order], "o-")
+    for x, y, lab in zip(xs, ys, labels):
+        ax.annotate(lab, (x, y), fontsize=6)
+    ax.set_xscale("log")
+    ax.set_xlabel("model size ratio")
+    ax.set_ylabel(metric)
+    ax.grid(True, alpha=0.3)
+    os.makedirs(os.path.join(output_dir, "fig"), exist_ok=True)
+    p = os.path.join(output_dir, "fig", f"interp_{metric}.png")
+    fig.savefig(p, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    return p
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description="result aggregation (process.py parity)")
+    parser.add_argument("--output_dir", default="./output", type=str)
+    parser.add_argument("--metric", default="Global-Accuracy", type=str)
+    args = parser.parse_args(argv)
+    rows = load_results(args.output_dir)
+    agg = aggregate(rows)
+    csv_path = export_table(agg, args.output_dir)
+    lc = make_learning_curves(rows, args.output_dir, args.metric)
+    interp = make_interpolation_plot(agg, args.output_dir, args.metric)
+    print(f"{len(rows)} results -> {csv_path}; {len(lc)} learning curves; interp={interp}")
+    return agg
+
+
+if __name__ == "__main__":
+    main()

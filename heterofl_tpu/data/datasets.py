@@ -76,7 +76,15 @@ class TokenDataset:
 # ---------------------------------------------------------------------------
 
 def _read_idx(path: str) -> np.ndarray:
-    """Parse an IDX (ubyte) file, gzip-transparent (ref src/datasets/mnist.py:159-180)."""
+    """Parse an IDX (ubyte) file, gzip-transparent (ref src/datasets/mnist.py:159-180).
+
+    Uncompressed files go through the native C++ parser when available."""
+    if not path.endswith(".gz"):
+        from .. import native
+
+        arr = native.read_idx(path)
+        if arr is not None:
+            return arr
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">I", f.read(4))[0]
@@ -111,8 +119,51 @@ def _load_mnist_like(root: str, split: str, data_name: str) -> Optional[ArrayDat
     return ArrayDataset(imgs, labels, 10, data_name)
 
 
+def _load_cifar_bin(root: str, split: str, data_name: str) -> Optional[ArrayDataset]:
+    """Parse the CIFAR *binary* distribution natively (C++ parser)."""
+    from .. import native
+
+    if data_name == "CIFAR10":
+        subdir, label_bytes, classes = "cifar-10-batches-bin", 1, 10
+        files = [f"data_batch_{i}.bin" for i in range(1, 6)] if split == "train" \
+            else ["test_batch.bin"]
+    else:
+        subdir, label_bytes, classes = "cifar-100-binary", 2, 100
+        files = ["train.bin"] if split == "train" else ["test.bin"]
+    base = None
+    for sub in ("", "raw"):
+        p = os.path.join(root, sub, subdir)
+        if os.path.isdir(p):
+            base = p
+            break
+    if base is None:
+        return None
+    imgs_parts, lab_parts = [], []
+    for fn in files:
+        path = os.path.join(base, fn)
+        if not os.path.exists(path):
+            return None
+        n = os.path.getsize(path) // (label_bytes + 3072)
+        out = native.read_cifar_bin(path, n, label_bytes)
+        if out is None:
+            # pure-NumPy fallback: same record layout, no native lib needed
+            raw = np.fromfile(path, np.uint8, n * (label_bytes + 3072))
+            rec = raw.reshape(n, label_bytes + 3072)
+            labels = rec[:, label_bytes - 1].astype(np.int64)
+            imgs = rec[:, label_bytes:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1)
+            out = (np.ascontiguousarray(imgs), labels)
+        imgs_parts.append(out[0])
+        lab_parts.append(out[1])
+    return ArrayDataset(np.concatenate(imgs_parts), np.concatenate(lab_parts), classes,
+                        data_name, augment=(split == "train"))
+
+
 def _load_cifar(root: str, split: str, data_name: str) -> Optional[ArrayDataset]:
-    """Parse CIFAR10/100 python-pickle batches (ref src/datasets/cifar.py:109-119)."""
+    """Parse CIFAR10/100 python-pickle batches (ref src/datasets/cifar.py:109-119);
+    the binary distribution is handled by the native parser first."""
+    ds = _load_cifar_bin(root, split, data_name)
+    if ds is not None:
+        return ds
     if data_name == "CIFAR10":
         archive, subdir = "cifar-10-python.tar.gz", "cifar-10-batches-py"
         files = [f"data_batch_{i}" for i in range(1, 6)] if split == "train" else ["test_batch"]

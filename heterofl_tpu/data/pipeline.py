@@ -78,20 +78,24 @@ def stack_client_shards(data: np.ndarray, target: np.ndarray,
     shorter shards are padded by repeating their first items with
     ``sample_mask == 0`` so padded examples carry zero loss weight.
     """
+    from .. import native
+
     sizes = [len(data_split[u]) for u in user_idx]
     n = max(sizes)
-    xs, ys, ms = [], [], []
+    all_idx, ms = [], []
     for u, sz in zip(user_idx, sizes):
         idx = np.asarray(data_split[u], dtype=np.int64)
         if sz < n:
             pad = idx[np.arange(n - sz) % sz]
             idx = np.concatenate([idx, pad])
-        xs.append(data[idx])
-        ys.append(target[idx])
+        all_idx.append(idx)
         m = np.zeros(n, dtype=np.float32)
         m[:sz] = 1.0
         ms.append(m)
-    return np.stack(xs), np.stack(ys), np.stack(ms)
+    flat = np.concatenate(all_idx)
+    x = native.permute_gather(data, flat).reshape((len(user_idx), n) + data.shape[1:])
+    y = target[flat].reshape(len(user_idx), n)
+    return x, y, np.stack(ms)
 
 
 def stack_client_token_rows(token_rows: np.ndarray, data_split: Dict[int, List[int]],

@@ -1,0 +1,59 @@
+"""Online per-channel mean/std over a dataset.
+
+Parity: ``src/utils.py:218-257`` (``make_stats`` + the ``Stats`` merging
+accumulator): batch-wise moment merging with the standard pooled-variance
+update, cached to ``{data_dir}/stats/{name}.npz``.  Used to normalise
+datasets that have no entry in ``DATASET_STATS``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class Stats:
+    """Mergeable per-channel mean/std (channel = last axis)."""
+
+    def __init__(self):
+        self.n = 0
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def update(self, batch: np.ndarray) -> None:
+        x = batch.reshape(-1, batch.shape[-1]).astype(np.float64)
+        n, mean = x.shape[0], x.mean(0)
+        std = x.std(0, ddof=1) if n > 1 else np.zeros_like(mean)
+        if self.n == 0:
+            self.n, self.mean, self.std = n, mean, std
+            return
+        m = float(self.n)
+        tot = m + n
+        new_mean = m / tot * self.mean + n / tot * mean
+        self.std = np.sqrt(m / tot * self.std ** 2 + n / tot * std ** 2
+                           + m * n / tot ** 2 * (self.mean - mean) ** 2)
+        self.mean = new_mean
+        self.n += n
+
+
+def compute_stats(data: np.ndarray, batch: int = 100) -> Tuple[np.ndarray, np.ndarray]:
+    """Channel stats of a uint8 image array (scaled to [0,1] like ToTensor)."""
+    st = Stats()
+    for i in range(0, len(data), batch):
+        st.update(data[i: i + batch].astype(np.float32) / 255.0)
+    return st.mean.astype(np.float32), st.std.astype(np.float32)
+
+
+def dataset_stats(name: str, data: np.ndarray, data_dir: str = "./data"
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Cached stats (ref make_stats caches to ./data/stats/{name}.pt)."""
+    path = os.path.join(data_dir, "stats", f"{name}.npz")
+    if os.path.exists(path):
+        z = np.load(path)
+        return z["mean"], z["std"]
+    mean, std = compute_stats(data)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    np.savez(path, mean=mean, std=std)
+    return mean, std

@@ -36,7 +36,7 @@ class CentralEngine:
         self.cfg = cfg
         self.mesh = mesh
         self.is_lm = model.meta.get("kind") == "transformer"
-        self.norm_stats = DATASET_STATS.get(cfg["data_name"])
+        self.norm_stats = cfg.get("norm_stats") or DATASET_STATS.get(cfg["data_name"])
         self.augment = cfg["data_name"].startswith("CIFAR")
         self._opt_init, self._opt_update = make_optimizer(cfg)
         self._epoch = None
@@ -127,6 +127,9 @@ class CentralExperiment:
                                 seed=seed, synthetic_sizes=cfg.get("synthetic_sizes"))
         self.cfg, self.dataset = process_dataset(cfg, dataset)
         cfg = self.cfg
+        from .common import _maybe_compute_norm_stats
+
+        _maybe_compute_norm_stats(cfg, self.dataset)
         self.tag = C.make_model_tag(seed, cfg)
         self.kind = "transformer" if cfg["model_name"] == "transformer" else "vision"
         self.model = make_model(cfg)

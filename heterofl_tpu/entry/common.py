@@ -98,6 +98,21 @@ def _batch_array(x: np.ndarray, b: int, pad_value=0) -> Tuple[np.ndarray, np.nda
     return x.reshape((s, b) + x.shape[1:]), w.reshape(s, b)
 
 
+def _maybe_compute_norm_stats(cfg: Dict[str, Any], dataset: Dict[str, Any]) -> None:
+    """Datasets without a DATASET_STATS entry get per-channel stats computed
+    from the train split (cached; ref utils.py:218-228 ``make_stats``)."""
+    from ..data.datasets import DATASET_STATS
+
+    if cfg.get("norm_stats") or cfg["data_name"] in DATASET_STATS:
+        return
+    if not hasattr(dataset["train"], "data"):
+        return
+    from ..data.stats import dataset_stats
+
+    mean, std = dataset_stats(cfg["data_name"], dataset["train"].data, cfg["data_dir"])
+    cfg["norm_stats"] = (tuple(float(x) for x in mean), tuple(float(x) for x in std))
+
+
 class FedExperiment:
     """One federated experiment (one seed): owns the data staging, engine,
     evaluator, logger and checkpoint loop."""
@@ -114,6 +129,7 @@ class FedExperiment:
                                 seed=seed, synthetic_sizes=cfg.get("synthetic_sizes"))
         self.cfg, self.dataset = process_dataset(cfg, dataset)
         cfg = self.cfg
+        _maybe_compute_norm_stats(cfg, self.dataset)
         self.model = make_model(cfg)
         n_data = max(1, cfg["mesh"].get("data", 1))
         n_clients = cfg["mesh"].get("clients", 0) or None

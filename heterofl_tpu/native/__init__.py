@@ -1,0 +1,107 @@
+"""ctypes bindings for the native data-loading kernels (loader.cpp).
+
+Compiled lazily with g++ on first use and cached next to the source; every
+entry point has a pure-Python/NumPy fallback, so the framework still works
+where no compiler exists.  (pybind11 is unavailable in this image; the C ABI
++ ctypes keeps the binding dependency-free.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "loader.cpp")
+_SO = os.path.join(_HERE, "_loader.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                tmp = f"{_SO}.{os.getpid()}.tmp"  # unique per process: parallel
+                # first-use jobs must not clobber each other's build output
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                     _SRC, "-o", tmp],
+                    check=True, capture_output=True)
+                os.replace(tmp, _SO)
+            lib = ctypes.CDLL(_SO)
+            lib.idx_header.restype = ctypes.c_int
+            lib.idx_read.restype = ctypes.c_int
+            lib.cifar_bin_read.restype = ctypes.c_int
+            lib.permute_gather_u8.restype = None
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_idx(path: str) -> Optional[np.ndarray]:
+    """Native IDX parse (uncompressed files); None -> caller falls back."""
+    lib = _load()
+    if lib is None or path.endswith(".gz"):
+        return None
+    dims = (ctypes.c_int64 * 4)()
+    ndim = ctypes.c_int()
+    if lib.idx_header(path.encode(), dims, ctypes.byref(ndim)) != 0:
+        return None
+    shape = tuple(dims[i] for i in range(ndim.value))
+    total = int(np.prod(shape))
+    out = np.empty(total, np.uint8)
+    if lib.idx_read(path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    ctypes.c_int64(total)) != 0:
+        return None
+    return out.reshape(shape)
+
+
+def read_cifar_bin(path: str, n: int, label_bytes: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native CIFAR-binary parse -> (images NHWC uint8, fine labels)."""
+    lib = _load()
+    if lib is None:
+        return None
+    imgs = np.empty((n, 32, 32, 3), np.uint8)
+    labels = np.empty(n, np.int64)
+    rc = lib.cifar_bin_read(path.encode(), ctypes.c_int64(n), ctypes.c_int(label_bytes),
+                            imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc != 0:
+        return None
+    return imgs, labels
+
+
+def permute_gather(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """out[i] = src[idx[i]] -- threaded native gather for big uint8 arrays,
+    NumPy fancy-indexing fallback otherwise."""
+    lib = _load()
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, np.int64)
+    if (lib is None or src.dtype != np.uint8 or src.nbytes < (1 << 20)
+            or len(idx) == 0 or idx.min() < 0 or idx.max() >= len(src)):
+        return src[idx]  # numpy path also raises on truly invalid indices
+    row_bytes = int(np.prod(src.shape[1:])) * src.itemsize
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    lib.permute_gather_u8(src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                          idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                          out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                          ctypes.c_int64(len(idx)), ctypes.c_int64(row_bytes),
+                          ctypes.c_int(os.cpu_count() or 1))
+    return out

@@ -1,0 +1,95 @@
+// Native data-loading kernels for heterofl_tpu.
+//
+// The reference is pure Python (SURVEY.md §2.4: no native components); this
+// library accelerates the host-side ingestion path that feeds the TPU:
+//   * IDX (MNIST-family) ubyte parsing (big-endian header + raw payload)
+//   * CIFAR-10/100 *binary* batch parsing (1-2 label bytes + 3072 px/record)
+//   * multi-threaded permutation-gather used to stack per-client shards
+// Exposed with a C ABI for ctypes (no pybind11 in this image).
+//
+// Build: see heterofl_tpu/native/__init__.py (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Parse an IDX header; returns ndim (<=4) and fills dims. Returns -1 on error.
+int idx_header(const char* path, int64_t* dims, int* ndim_out) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char magic[4];
+    if (fread(magic, 1, 4, f) != 4) { fclose(f); return -1; }
+    if (magic[0] != 0 || magic[1] != 0 || magic[2] != 0x08) { fclose(f); return -1; }
+    int ndim = magic[3];
+    if (ndim < 1 || ndim > 4) { fclose(f); return -1; }
+    for (int i = 0; i < ndim; ++i) {
+        unsigned char b[4];
+        if (fread(b, 1, 4, f) != 4) { fclose(f); return -1; }
+        dims[i] = ((int64_t)b[0] << 24) | ((int64_t)b[1] << 16) | ((int64_t)b[2] << 8) | b[3];
+    }
+    *ndim_out = ndim;
+    fclose(f);
+    return 0;
+}
+
+// Read the IDX payload (uint8) into out (caller allocates total bytes).
+int idx_read(const char* path, uint8_t* out, int64_t total) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char magic[4];
+    if (fread(magic, 1, 4, f) != 4) { fclose(f); return -1; }
+    int ndim = magic[3];
+    if (fseek(f, 4 + 4 * ndim, SEEK_SET) != 0) { fclose(f); return -1; }
+    int64_t got = (int64_t)fread(out, 1, (size_t)total, f);
+    fclose(f);
+    return got == total ? 0 : -1;
+}
+
+// Parse a CIFAR binary batch file: n records of (label_bytes, 3072 pixels).
+// label_bytes = 1 (CIFAR-10) or 2 (CIFAR-100: coarse, fine). Pixels are
+// CHW planes; we emit HWC uint8. labels gets the last label byte (fine).
+int cifar_bin_read(const char* path, int64_t n, int label_bytes,
+                   uint8_t* images_hwc, int64_t* labels) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    const int HW = 32 * 32;
+    std::vector<uint8_t> rec(label_bytes + 3 * HW);
+    for (int64_t i = 0; i < n; ++i) {
+        if (fread(rec.data(), 1, rec.size(), f) != rec.size()) { fclose(f); return -1; }
+        labels[i] = rec[label_bytes - 1];
+        const uint8_t* px = rec.data() + label_bytes;
+        uint8_t* out = images_hwc + i * 3 * HW;
+        for (int p = 0; p < HW; ++p) {
+            out[3 * p + 0] = px[p];
+            out[3 * p + 1] = px[HW + p];
+            out[3 * p + 2] = px[2 * HW + p];
+        }
+    }
+    fclose(f);
+    return 0;
+}
+
+// out[i, :] = src[idx[i], :] for row_bytes-wide rows, threaded.
+void permute_gather_u8(const uint8_t* src, const int64_t* idx, uint8_t* out,
+                       int64_t rows, int64_t row_bytes, int n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    auto work = [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i)
+            std::memcpy(out + i * row_bytes, src + idx[i] * row_bytes, (size_t)row_bytes);
+    };
+    if (n_threads == 1 || rows < 1024) { work(0, rows); return; }
+    std::vector<std::thread> ts;
+    int64_t chunk = (rows + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+        int64_t lo = t * chunk, hi = lo + chunk > rows ? rows : lo + chunk;
+        if (lo >= hi) break;
+        ts.emplace_back(work, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+}
+
+}  // extern "C"

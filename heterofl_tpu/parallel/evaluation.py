@@ -32,7 +32,7 @@ class Evaluator:
         self.cfg = cfg
         self.mesh = mesh
         self.is_lm = model.meta.get("kind") == "transformer"
-        self.norm_stats = DATASET_STATS.get(cfg["data_name"])
+        self.norm_stats = cfg.get("norm_stats") or DATASET_STATS.get(cfg["data_name"])
         self.bptt = cfg.get("bptt", 64)
         self._sbn = None
         self._users = None

@@ -64,8 +64,7 @@ class RoundEngine:
         self.batch_size = cfg["batch_size"]["train"]
         self.is_lm = model.meta.get("kind") == "transformer"
         self.bptt = cfg.get("bptt", 64)
-        stats = DATASET_STATS.get(cfg["data_name"])
-        self.norm_stats = stats
+        self.norm_stats = cfg.get("norm_stats") or DATASET_STATS.get(cfg["data_name"])
         self.augment = cfg["data_name"].startswith("CIFAR")
         self.fix_rates = np.asarray(cfg["model_rate"], np.float32) \
             if cfg["model_split_mode"] == "fix" else None

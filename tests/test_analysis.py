@@ -1,0 +1,125 @@
+import json
+import os
+import pickle
+
+import numpy as np
+
+from heterofl_tpu.analysis.make import build_controls, combination_modes, interp_modes, make_script
+from heterofl_tpu.analysis.process import aggregate, export_table, load_results, parse_tag
+from heterofl_tpu.analysis.summary import make_summary, profile_model
+
+from test_models import small_cfg
+
+
+def test_grid_modes():
+    combos = combination_modes()
+    assert "a1-b1" in combos and "a1-b1-c1-d1-e1" in combos
+    assert all("-" in c for c in combos)  # singles excluded
+    assert len(combos) == 2 ** 5 - 1 - 5
+    interp = interp_modes()
+    assert "a1-b9" in interp and "a9-b1" in interp and "d5-e5" in interp
+    assert len(interp) == 9 * 10  # 9 proportions x C(5,2) pairs
+
+
+def test_build_controls_and_script(tmp_path, monkeypatch):
+    controls = build_controls("resnet18", 1, "iid")
+    assert "1_100_0.1_iid_fix_a1_bn_1_1" in controls
+    assert any(c.startswith("1_100_0.1_iid_dynamic_a1-b1") for c in controls)
+    s = make_script("train", "resnet18", 1, "iid", round_size=4, num_experiments=2)
+    assert "python -m heterofl_tpu.entry.train_classifier_fed" in s
+    assert "wait" in s and s.count("--init_seed 1") == len(controls)
+    ab = build_controls("resnet18", 1, "iid", ablation=True)
+    assert any("_gn_" in c for c in ab) and any("_0_1" in c for c in ab)
+
+
+def test_profile_and_summary(tmp_path):
+    cfg = small_cfg("conv")
+    cfg["output_dir"] = str(tmp_path)
+    prof = profile_model(cfg, 1.0, batch_size=2)
+    # conv [8,16]: block0 3*3*1*8(+8) + block1 3*3*8*16(+16) + bn params + linear 16*10+10
+    assert prof["num_params"] > 1000
+    half = profile_model(cfg, 0.5, batch_size=2)
+    assert half["num_params"] < prof["num_params"]
+    out = make_summary(cfg, rates=[1.0, 0.5], output_dir=str(tmp_path))
+    assert "| a | 1 |" in out["report"]
+    assert os.path.exists(tmp_path / "summary.md")
+    assert os.path.exists(tmp_path / "result" / "MNIST_conv_a.pkl")
+
+
+def test_process_aggregation(tmp_path):
+    os.makedirs(tmp_path / "result")
+    for seed in (0, 1):
+        tag = f"{seed}_MNIST_label_conv_1_8_0.5_iid_fix_a1_bn_1_1"
+        bundle = {"logger_history": {"test/Global-Accuracy": [50.0 + seed * 10],
+                                     "test/Global-Loss": [1.0]},
+                  "train_history": {"test/Global-Accuracy": [10.0, 50.0 + seed * 10]}}
+        with open(tmp_path / "result" / f"{tag}.pkl", "wb") as f:
+            pickle.dump(bundle, f)
+    rows = load_results(str(tmp_path))
+    assert len(rows) == 2
+    meta = parse_tag(rows[0]["tag"])
+    assert meta["model_mode"] == "a1" and meta["data_name"] == "MNIST"
+    agg = aggregate(rows)
+    assert len(agg) == 1
+    g = next(iter(agg.values()))
+    assert g["n_seeds"] == 2
+    assert g["mean"]["Global-Accuracy"] == 55.0 and abs(g["std"]["Global-Accuracy"] - 5.0) < 1e-9
+    csv_path = export_table(agg, str(tmp_path))
+    assert os.path.exists(csv_path)
+    content = open(csv_path).read()
+    assert "Global-Accuracy_mean" in content and "55" in content
+
+
+def test_norm_stats_fallback(tmp_path):
+    """Datasets absent from DATASET_STATS get computed (and cached) channel
+    stats wired into the engines via cfg['norm_stats']."""
+    from heterofl_tpu.data.stats import compute_stats, dataset_stats
+    from heterofl_tpu.entry.common import _maybe_compute_norm_stats
+    from heterofl_tpu.data import fetch_dataset
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 255, (200, 8, 8, 3), dtype=np.uint8)
+    mean, std = compute_stats(data)
+    ref = (data.astype(np.float64) / 255.0).reshape(-1, 3)
+    np.testing.assert_allclose(mean, ref.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(std, ref.std(0, ddof=1), rtol=1e-3)
+    m2, s2 = dataset_stats("FakeSet", data, str(tmp_path))
+    assert os.path.exists(tmp_path / "stats" / "FakeSet.npz")
+    m3, _ = dataset_stats("FakeSet", np.zeros_like(data), str(tmp_path))  # cache hit
+    np.testing.assert_allclose(m2, m3)
+
+    class FakeDS:
+        pass
+
+    ds = FakeDS()
+    ds.data = data
+    cfg = {"data_name": "FakeSet", "data_dir": str(tmp_path)}
+    _maybe_compute_norm_stats(cfg, {"train": ds})
+    assert "norm_stats" in cfg and len(cfg["norm_stats"][0]) == 3
+    # known datasets are untouched
+    cfg2 = {"data_name": "MNIST", "data_dir": str(tmp_path)}
+    _maybe_compute_norm_stats(cfg2, {"train": ds})
+    assert "norm_stats" not in cfg2
+
+
+def test_cifar_bin_python_fallback(tmp_path, monkeypatch):
+    """CIFAR binary parses identically without the native library."""
+    from heterofl_tpu import native
+    from heterofl_tpu.data.datasets import _load_cifar_bin
+
+    rng = np.random.default_rng(4)
+    n = 10
+    imgs_chw = rng.integers(0, 255, (n, 3, 32, 32), dtype=np.uint8)
+    labels = rng.integers(0, 10, n, dtype=np.uint8)
+    base = tmp_path / "cifar-10-batches-bin"
+    os.makedirs(base)
+    for fn in [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]:
+        with open(base / fn, "wb") as f:
+            for i in range(n):
+                f.write(bytes([labels[i]]))
+                f.write(imgs_chw[i].tobytes())
+    monkeypatch.setattr(native, "read_cifar_bin", lambda *a, **k: None)
+    ds = _load_cifar_bin(str(tmp_path), "test", "CIFAR10")
+    assert ds is not None
+    np.testing.assert_array_equal(ds.data, imgs_chw.transpose(0, 2, 3, 1))
+    np.testing.assert_array_equal(ds.target, labels.astype(np.int64))
