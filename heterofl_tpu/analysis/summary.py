@@ -111,7 +111,8 @@ def main(argv=None):
         cfg["control"] = C.parse_control_name(args.control_name)
     cfg = C.process_control(cfg)
     dataset = fetch_dataset(cfg["data_name"], cfg["data_dir"], synthetic=cfg["synthetic"],
-                            synthetic_sizes=cfg.get("synthetic_sizes"))
+                            synthetic_sizes=cfg.get("synthetic_sizes"),
+                            subset=cfg.get("subset", "label"))
     cfg, _ = process_dataset(cfg, dataset)
     out = make_summary(cfg)
     print(out["report"])

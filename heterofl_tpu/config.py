@@ -185,8 +185,8 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
     # Per-dataset hyperparameters (ref src/utils.py:150-212).
     data_name = cfg["data_name"]
     split = cfg["data_split_mode"]
-    if data_name in ("MNIST", "FashionMNIST"):
-        cfg["data_shape"] = [28, 28, 1]  # NHWC (reference is CHW [1,28,28])
+    if data_name in ("MNIST", "FashionMNIST", "EMNIST", "Omniglot"):
+        cfg["data_shape"] = [105, 105, 1] if data_name == "Omniglot" else [28, 28, 1]  # NHWC
         cfg["optimizer_name"] = "SGD"
         cfg["lr"] = 1e-2
         cfg["momentum"] = 0.9

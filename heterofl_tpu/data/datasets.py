@@ -203,6 +203,108 @@ def _load_cifar(root: str, split: str, data_name: str) -> Optional[ArrayDataset]
     return ArrayDataset(data, target, classes, data_name, augment=(split == "train"))
 
 
+_EMNIST_CLASSES = {"byclass": 62, "bymerge": 47, "balanced": 47, "letters": 26,
+                   "digits": 10, "mnist": 10}
+
+
+def _load_emnist(root: str, split: str, subset: str) -> Optional[ArrayDataset]:
+    """EMNIST idx files (ref src/datasets/mnist.py EMNIST subsets)."""
+    subset = subset if subset in _EMNIST_CLASSES else "balanced"
+    img_p = _find(root, f"emnist-{subset}-{split}-images-idx3-ubyte")
+    lbl_p = _find(root, f"emnist-{subset}-{split}-labels-idx1-ubyte")
+    if img_p is None or lbl_p is None:
+        return None
+    # EMNIST ships images column-major; transpose to match the reference
+    # pipeline (ref src/datasets/mnist.py EMNIST np.transpose(img, [0,2,1])).
+    imgs = _read_idx(img_p).transpose(0, 2, 1)[..., None]
+    labels = _read_idx(lbl_p).astype(np.int64)
+    if subset == "letters":
+        labels = labels - 1  # letters are 1-indexed upstream
+    return ArrayDataset(imgs, labels, _EMNIST_CLASSES[subset], "EMNIST")
+
+
+def _class_dirs(base: str):
+    """Deepest directories containing image files, sorted."""
+    out = []
+    for dirpath, _, filenames in sorted(os.walk(base)):
+        if any(f.lower().endswith((".png", ".jpg", ".jpeg")) for f in filenames):
+            out.append(dirpath)
+    return out
+
+
+def _load_image_folder(root: str, split: str, data_name: str,
+                       size: Optional[tuple] = None) -> Optional[ArrayDataset]:
+    """Generic class-per-subdirectory image tree (ref src/datasets/folder.py):
+    ``{root}/{split}/{class_name}/*.png|jpg``.
+
+    Omniglot follows the reference's split (ref src/datasets/omniglot.py):
+    ONE shared class enumeration over ``images_background`` +
+    ``images_evaluation`` (1623 characters), split per-example by drawing
+    index (``_NN`` suffix <= 10 -> train, > 10 -> test).
+
+    Mixed image sizes are resized to the first image's size (``size``
+    overrides).
+    """
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+
+    def find_dir(sub):
+        for s in (sub, os.path.join("raw", sub)):
+            p = os.path.join(root, s)
+            if os.path.isdir(p):
+                return p
+        return None
+
+    if data_name == "Omniglot":
+        bases = [b for b in (find_dir("images_background"), find_dir("images_evaluation"))
+                 if b is not None]
+        if not bases:
+            return None
+        classes = [d for b in bases for d in _class_dirs(b)]
+    else:
+        base = find_dir(split)
+        if base is None:
+            return None
+        classes = _class_dirs(base)
+    if not classes:
+        return None
+
+    def want(fn: str, pos: int) -> bool:
+        if data_name != "Omniglot":
+            return True
+        stem = os.path.splitext(fn)[0]
+        try:
+            draw = int(stem.rsplit("_", 1)[-1])
+        except ValueError:
+            draw = pos + 1
+        return (draw <= 10) == (split == "train")
+
+    imgs, labels = [], []
+    target_size = size
+    for ci, cdir in enumerate(classes):
+        files = [f for f in sorted(os.listdir(cdir))
+                 if f.lower().endswith((".png", ".jpg", ".jpeg"))]
+        for pos, fn in enumerate(files):
+            if not want(fn, pos):
+                continue
+            with Image.open(os.path.join(cdir, fn)) as im:
+                im = im.convert("L" if data_name == "Omniglot" else "RGB")
+                if target_size is None:
+                    target_size = im.size
+                elif im.size != target_size:
+                    im = im.resize(target_size)
+                arr = np.asarray(im, np.uint8)
+            if arr.ndim == 2:
+                arr = arr[..., None]
+            imgs.append(arr)
+            labels.append(ci)
+    if not imgs:
+        return None
+    return ArrayDataset(np.stack(imgs), np.asarray(labels, np.int64), len(classes), data_name)
+
+
 _LM_FILES = {
     "PennTreebank": {"train": "ptb.train.txt", "valid": "ptb.valid.txt", "test": "ptb.test.txt", "dir": ""},
     "WikiText2": {"train": "wiki.train.tokens", "valid": "wiki.valid.tokens", "test": "wiki.test.tokens",
@@ -269,8 +371,8 @@ def _load_lm(root: str, split: str, data_name: str) -> Optional[TokenDataset]:
 def synthetic_vision(data_name: str, split: str, n: Optional[int] = None, seed: int = 0) -> ArrayDataset:
     """Class-conditional random images: mean brightness and a per-class spatial
     stripe depend on the label so that models can actually learn from it."""
-    shape = (28, 28, 1) if data_name in ("MNIST", "FashionMNIST") else (32, 32, 3)
-    classes = 100 if data_name == "CIFAR100" else 10
+    shape = (28, 28, 1) if data_name in ("MNIST", "FashionMNIST", "EMNIST") else (32, 32, 3)
+    classes = {"CIFAR100": 100, "EMNIST": 47}.get(data_name, 10)
     if n is None:
         n = 2000 if split == "train" else 500
     rng = np.random.default_rng(seed + (0 if split == "train" else 1))
@@ -311,16 +413,20 @@ def synthetic_lm(data_name: str, split: str, n_tokens: int = 200_000, vocab_size
 # Registry
 # ---------------------------------------------------------------------------
 
-VISION_DATASETS = ("MNIST", "FashionMNIST", "CIFAR10", "CIFAR100")
+VISION_DATASETS = ("MNIST", "FashionMNIST", "EMNIST", "CIFAR10", "CIFAR100")
+FOLDER_DATASETS = ("Omniglot", "ImageNet", "ImageFolder")
 LM_DATASETS = ("PennTreebank", "WikiText2", "WikiText103")
 
 
 def fetch_dataset(data_name: str, data_dir: str = "./data", synthetic: bool = False,
-                  seed: int = 0, synthetic_sizes: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
+                  seed: int = 0, synthetic_sizes: Optional[Dict[str, int]] = None,
+                  subset: str = "label") -> Dict[str, Any]:
     """Return ``{'train': dataset, 'test': dataset}`` (ref src/data.py:10-34).
 
     Resolution order: on-disk files under ``{data_dir}/{data_name}``, else a
     deterministic synthetic dataset (``synthetic=True`` forces the latter).
+    Folder datasets (Omniglot/ImageNet/ImageFolder) have no synthetic twin
+    and raise if absent.
     """
     root = os.path.join(data_dir, data_name)
     out: Dict[str, Any] = {}
@@ -329,8 +435,16 @@ def fetch_dataset(data_name: str, data_dir: str = "./data", synthetic: bool = Fa
         if not synthetic:
             if data_name in ("MNIST", "FashionMNIST"):
                 ds = _load_mnist_like(root, split, data_name)
+            elif data_name == "EMNIST":
+                ds = _load_emnist(root, split, subset)
             elif data_name in ("CIFAR10", "CIFAR100"):
                 ds = _load_cifar(root, split, data_name)
+            elif data_name in FOLDER_DATASETS:
+                ds = _load_image_folder(root, split, data_name)
+                if ds is None:
+                    raise FileNotFoundError(
+                        f"{data_name} expects an image tree under {root}/<split>/<class>/ "
+                        f"(Omniglot: images_background/images_evaluation)")
             elif data_name in LM_DATASETS:
                 ds = _load_lm(root, split, data_name)
             else:

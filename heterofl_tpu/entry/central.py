@@ -124,7 +124,8 @@ class CentralExperiment:
         self.rng = np.random.default_rng(seed)
         self.host_key = jax.random.key(seed)
         dataset = fetch_dataset(cfg["data_name"], cfg["data_dir"], synthetic=cfg["synthetic"],
-                                seed=seed, synthetic_sizes=cfg.get("synthetic_sizes"))
+                                seed=seed, synthetic_sizes=cfg.get("synthetic_sizes"),
+                                subset=cfg.get("subset", "label"))
         self.cfg, self.dataset = process_dataset(cfg, dataset)
         cfg = self.cfg
         from .common import _maybe_compute_norm_stats

@@ -126,7 +126,8 @@ class FedExperiment:
         self.host_key = jax.random.key(seed)
 
         dataset = fetch_dataset(cfg["data_name"], cfg["data_dir"], synthetic=cfg["synthetic"],
-                                seed=seed, synthetic_sizes=cfg.get("synthetic_sizes"))
+                                seed=seed, synthetic_sizes=cfg.get("synthetic_sizes"),
+                                subset=cfg.get("subset", "label"))
         self.cfg, self.dataset = process_dataset(cfg, dataset)
         cfg = self.cfg
         _maybe_compute_norm_stats(cfg, self.dataset)
@@ -141,6 +142,13 @@ class FedExperiment:
         self.evaluator = Evaluator(self.model, cfg, self.mesh)
         self.scheduler = make_scheduler(cfg)
         self.num_active = int(np.ceil(cfg["frac"] * cfg["num_users"]))
+        if cfg.get("strategy", "masked") not in ("masked", "sliced"):
+            raise ValueError(f"Not valid strategy: {cfg.get('strategy')!r}")
+        self.sliced = None
+        if cfg.get("strategy") == "sliced":
+            from ..fed.sliced import SlicedFederation
+
+            self.sliced = SlicedFederation(cfg)
 
     # -- staging -------------------------------------------------------
 
@@ -193,15 +201,37 @@ class FedExperiment:
         user_idx = self.sample_users()
         key = jax.random.fold_in(self.host_key, epoch)
         t0 = time.time()
-        params, ms = self.engine.train_round(params, key, lr, user_idx, self.train_data)
-        ms = {k: np.asarray(v) for k, v in ms.items()}
+        if self.sliced is not None:
+            rates = np.asarray(sample_model_rates(jax.random.fold_in(key, 7), self.cfg,
+                                                  jnp.asarray(user_idx)))
+            new_np, ms = self.sliced.train_round(
+                {k: np.asarray(v) for k, v in params.items()}, user_idx, rates,
+                self.train_data, lr, key)
+            params = {k: jnp.asarray(v) for k, v in new_np.items()}
+        else:
+            params, ms = self.engine.train_round(params, key, lr, user_idx, self.train_data)
+            ms = {k: np.asarray(v) for k, v in ms.items()}
         named = summarize_sums(ms, self.cfg["model_name"])
         logger.append(named, "train", n=float(ms["n"].sum()))
+        # running ETA over steady-state rounds, parity with the reference's
+        # telemetry (train_classifier_fed.py:105-119); the first processed
+        # round (compile) is excluded from the mean
+        dt = time.time() - t0
+        if not hasattr(self, "_first_round_done"):
+            self._first_round_done = True
+        else:
+            self._round_times = getattr(self, "_round_times", []) + [dt]
+        mean_dt = float(np.mean(self._round_times)) if getattr(self, "_round_times", []) else dt
+        remain = self.cfg["num_epochs"]["global"] - epoch
+        import datetime
+
+        eta = datetime.timedelta(seconds=round(mean_dt * remain))
         info = {"info": [f"Model: {self.tag}",
                          f"Train Epoch: {epoch}",
                          f"Learning rate: {lr:g}",
                          f"Rates: {sorted(set(ms['rate'][ms['n'] > 0].tolist()))}",
-                         f"Round time: {time.time() - t0:.2f}s"]}
+                         f"Round time: {dt:.2f}s",
+                         f"Experiment Finished Time: {eta}"]}
         logger.append(info, "train", mean=False)
         logger.write("train", list(named))
         return params

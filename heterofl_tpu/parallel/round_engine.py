@@ -87,11 +87,12 @@ class RoundEngine:
             img = x_u8.astype(jnp.float32)
         return img
 
-    def _local_train_vision(self, params, wr, x, y, sm, lm, key, lr):
+    def _local_train_vision(self, params, wr, x, y, sm, lm, key, lr, scaler_rate=None):
         model, B, E = self.model, self.batch_size, self.local_epochs
         N = x.shape[0]
         S = _ceil_div(N, B)
         SB = S * B
+        sr = wr if scaler_rate is None else scaler_rate
         p = mask_params(params, model.specs, model.groups, wr)
         opt = self._opt_init(p)
         ekeys = jax.random.split(jax.random.fold_in(key, 1), E)
@@ -122,7 +123,7 @@ class RoundEngine:
             batch = {"img": img, "label": y[ids]}
 
             def loss_fn(p):
-                out, _ = model.apply(p, batch, train=True, width_rate=wr, scaler_rate=wr,
+                out, _ = model.apply(p, batch, train=True, width_rate=wr, scaler_rate=sr,
                                      label_mask=lm, sample_weight=w,
                                      rng=jax.random.fold_in(key, 5000 + t))
                 return out["loss"], out["score"]
@@ -145,11 +146,12 @@ class RoundEngine:
         (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S))
         return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
 
-    def _local_train_lm(self, params, wr, rows, lm, key, lr):
+    def _local_train_lm(self, params, wr, rows, lm, key, lr, scaler_rate=None):
         model, E, bptt = self.model, self.local_epochs, self.bptt
         R, T = rows.shape
         S = _ceil_div(T, bptt)
         pad = S * bptt - T
+        sr = wr if scaler_rate is None else scaler_rate
         rows_p = jnp.pad(rows, ((0, 0), (0, pad)))
         wpos = jnp.pad(jnp.ones((R, T), jnp.float32), ((0, 0), (0, pad)))
         p = mask_params(params, model.specs, model.groups, wr)
@@ -163,7 +165,7 @@ class RoundEngine:
 
             def loss_fn(p):
                 out, _ = model.apply(p, {"label": lab}, train=True, width_rate=wr,
-                                     scaler_rate=wr, label_mask=lm, sample_weight=w,
+                                     scaler_rate=sr, label_mask=lm, sample_weight=w,
                                      rng=jax.random.fold_in(key, 5000 + t))
                 return out["loss"]
 

@@ -1,3 +1,5 @@
+import os
+
 import numpy as np
 import pytest
 
@@ -100,3 +102,66 @@ def test_vocab_semantics():
     assert v["missing"] == 0 and v[99] == "<ukn>"
     assert "hello" in v and 2 in v and 99 not in v
     assert len(v) == 3
+
+
+def test_emnist_synthetic_and_idx(tmp_path):
+    import struct
+
+    d = fetch_dataset("EMNIST", synthetic=True)
+    assert d["train"].classes_size == 47
+    # on-disk idx path
+    from heterofl_tpu.data.datasets import _load_emnist
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, (30, 28, 28), dtype=np.uint8)
+    labels = rng.integers(1, 27, 30, dtype=np.uint8)  # letters: 1-indexed
+
+    def write_idx(path, arr):
+        with open(path, "wb") as f:
+            f.write(struct.pack(">BBBB", 0, 0, 0x08, arr.ndim))
+            for dim in arr.shape:
+                f.write(struct.pack(">I", dim))
+            f.write(arr.tobytes())
+
+    write_idx(str(tmp_path / "emnist-letters-train-images-idx3-ubyte"), imgs)
+    write_idx(str(tmp_path / "emnist-letters-train-labels-idx1-ubyte"), labels)
+    ds = _load_emnist(str(tmp_path), "train", "letters")
+    assert ds.classes_size == 26
+    assert ds.target.min() >= 0 and ds.target.max() <= 25
+
+
+def test_image_folder_and_omniglot(tmp_path):
+    from PIL import Image
+
+    from heterofl_tpu.data.datasets import _load_image_folder
+
+    rng = np.random.default_rng(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "train" / cls
+        os.makedirs(d)
+        for i in range(3):
+            Image.fromarray(rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)).save(d / f"{i}.png")
+    ds = _load_image_folder(str(tmp_path), "train", "ImageFolder")
+    assert ds.classes_size == 2 and len(ds) == 6
+    assert ds.data.shape == (6, 16, 16, 3)
+    # omniglot layout: ONE class enumeration over background+evaluation,
+    # per-example split by drawing index (<=10 train, >10 test)
+    og = tmp_path / "OG"
+    for sub, alpha in (("images_background", "Greek"), ("images_evaluation", "Futurama")):
+        for ch in ("c1", "c2"):
+            d = og / sub / alpha / ch
+            os.makedirs(d)
+            for draw in (1, 11):
+                Image.fromarray(rng.integers(0, 255, (10, 10), dtype=np.uint8)).save(
+                    d / f"{ch}_{draw:02d}.png")
+    tr = _load_image_folder(str(og), "train", "Omniglot")
+    te = _load_image_folder(str(og), "test", "Omniglot")
+    assert tr.classes_size == te.classes_size == 4  # shared class set
+    assert len(tr) == 4 and len(te) == 4  # one drawing each side per character
+    assert set(tr.target.tolist()) == set(te.target.tolist()) == {0, 1, 2, 3}
+
+
+def test_fetch_folder_dataset_missing_raises(tmp_path):
+    import pytest as _pytest
+
+    with _pytest.raises(FileNotFoundError):
+        fetch_dataset("Omniglot", data_dir=str(tmp_path))
