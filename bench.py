@@ -45,6 +45,8 @@ def main():
     cfg["data_name"] = "CIFAR10"
     cfg["model_name"] = "resnet18"
     cfg["synthetic"] = True
+    # bf16 matmul/conv operands with f32 accumulation: the TPU MXU recipe.
+    cfg["compute_dtype"] = os.environ.get("BENCH_DTYPE", "bfloat16")
     cfg = C.process_control(cfg)
 
     hidden = os.environ.get("BENCH_HIDDEN")

@@ -207,6 +207,26 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
             cfg["milestones"] = [100]
         else:
             raise ValueError("Not valid data_split_mode")
+    elif data_name in ("ImageNet", "ImageFolder"):
+        # shape is provisional; process_dataset overwrites it from the loaded
+        # tree (folder datasets have data-defined geometry)
+        cfg["data_shape"] = [224, 224, 3]
+        cfg["optimizer_name"] = "SGD"
+        cfg["lr"] = 1e-1
+        cfg["momentum"] = 0.9
+        cfg["weight_decay"] = 5e-4
+        cfg["scheduler_name"] = "MultiStepLR"
+        cfg["factor"] = 0.1
+        if split == "iid" or "non-iid" in split:
+            cfg["num_epochs"] = {"global": 400, "local": 5}
+            cfg["batch_size"] = {"train": 10, "test": 50}
+            cfg["milestones"] = [150, 250]
+        elif split == "none":
+            cfg["num_epochs"] = 400
+            cfg["batch_size"] = {"train": 100, "test": 500}
+            cfg["milestones"] = [150, 250]
+        else:
+            raise ValueError("Not valid data_split_mode")
     elif data_name in ("CIFAR10", "CIFAR100"):
         cfg["data_shape"] = [32, 32, 3]
         cfg["optimizer_name"] = "SGD"

@@ -209,7 +209,11 @@ _EMNIST_CLASSES = {"byclass": 62, "bymerge": 47, "balanced": 47, "letters": 26,
 
 def _load_emnist(root: str, split: str, subset: str) -> Optional[ArrayDataset]:
     """EMNIST idx files (ref src/datasets/mnist.py EMNIST subsets)."""
-    subset = subset if subset in _EMNIST_CLASSES else "balanced"
+    if subset in ("label", None, ""):
+        subset = "balanced"  # cfg default 'label' is the reference's target-key
+    if subset not in _EMNIST_CLASSES:
+        raise ValueError(f"Not valid EMNIST subset: {subset!r} "
+                         f"(one of {sorted(_EMNIST_CLASSES)})")
     img_p = _find(root, f"emnist-{subset}-{split}-images-idx3-ubyte")
     lbl_p = _find(root, f"emnist-{subset}-{split}-labels-idx1-ubyte")
     if img_p is None or lbl_p is None:
@@ -368,11 +372,15 @@ def _load_lm(root: str, split: str, data_name: str) -> Optional[TokenDataset]:
 # Deterministic synthetic fallback
 # ---------------------------------------------------------------------------
 
-def synthetic_vision(data_name: str, split: str, n: Optional[int] = None, seed: int = 0) -> ArrayDataset:
+def synthetic_vision(data_name: str, split: str, n: Optional[int] = None, seed: int = 0,
+                     subset: str = "balanced") -> ArrayDataset:
     """Class-conditional random images: mean brightness and a per-class spatial
     stripe depend on the label so that models can actually learn from it."""
     shape = (28, 28, 1) if data_name in ("MNIST", "FashionMNIST", "EMNIST") else (32, 32, 3)
-    classes = {"CIFAR100": 100, "EMNIST": 47}.get(data_name, 10)
+    if data_name == "EMNIST":
+        classes = _EMNIST_CLASSES.get(subset if subset in _EMNIST_CLASSES else "balanced", 47)
+    else:
+        classes = {"CIFAR100": 100}.get(data_name, 10)
     if n is None:
         n = 2000 if split == "train" else 500
     rng = np.random.default_rng(seed + (0 if split == "train" else 1))
@@ -451,8 +459,11 @@ def fetch_dataset(data_name: str, data_dir: str = "./data", synthetic: bool = Fa
                 raise ValueError("Not valid dataset name")
         if ds is None:
             n = (synthetic_sizes or {}).get(split)
+            if data_name in FOLDER_DATASETS:
+                raise ValueError(f"{data_name} has no synthetic twin; provide the "
+                                 f"image tree under {root}")
             if data_name in VISION_DATASETS:
-                ds = synthetic_vision(data_name, split, n=n, seed=seed)
+                ds = synthetic_vision(data_name, split, n=n, seed=seed, subset=subset)
             elif data_name in LM_DATASETS:
                 ds = synthetic_lm(data_name, split, n_tokens=n or 200_000, seed=seed)
             else:

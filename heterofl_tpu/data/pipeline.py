@@ -24,6 +24,7 @@ def process_dataset(cfg: Dict, dataset: Dict) -> Tuple[Dict, Dict]:
     dataset = dict(dataset)
     if hasattr(dataset["train"], "classes_size"):
         cfg["classes_size"] = dataset["train"].classes_size
+        cfg["data_shape"] = list(dataset["train"].data.shape[1:])
     else:
         cfg["vocab"] = dataset["train"].vocab
         cfg["num_tokens"] = len(dataset["train"].vocab)

@@ -11,6 +11,7 @@ logging and checkpointing live on the host.
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
 import math
 import os
@@ -142,6 +143,8 @@ class FedExperiment:
         self.evaluator = Evaluator(self.model, cfg, self.mesh)
         self.scheduler = make_scheduler(cfg)
         self.num_active = int(np.ceil(cfg["frac"] * cfg["num_users"]))
+        self._round_times: List[float] = []  # steady-state round durations (ETA)
+        self._first_round_done = False
         if cfg.get("strategy", "masked") not in ("masked", "sliced"):
             raise ValueError(f"Not valid strategy: {cfg.get('strategy')!r}")
         self.sliced = None
@@ -217,14 +220,12 @@ class FedExperiment:
         # telemetry (train_classifier_fed.py:105-119); the first processed
         # round (compile) is excluded from the mean
         dt = time.time() - t0
-        if not hasattr(self, "_first_round_done"):
-            self._first_round_done = True
+        if self._first_round_done:
+            self._round_times.append(dt)
         else:
-            self._round_times = getattr(self, "_round_times", []) + [dt]
-        mean_dt = float(np.mean(self._round_times)) if getattr(self, "_round_times", []) else dt
+            self._first_round_done = True  # exclude the compile round
+        mean_dt = float(np.mean(self._round_times)) if self._round_times else dt
         remain = self.cfg["num_epochs"]["global"] - epoch
-        import datetime
-
         eta = datetime.timedelta(seconds=round(mean_dt * remain))
         info = {"info": [f"Model: {self.tag}",
                          f"Train Epoch: {epoch}",

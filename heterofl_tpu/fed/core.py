@@ -50,9 +50,13 @@ def sample_model_rates(key: jax.Array, cfg: Dict[str, Any],
     if cfg["model_split_mode"] == "fix":
         return jnp.take(jnp.asarray(cfg["model_rate"], jnp.float32), user_idx)
     if cfg["model_split_mode"] == "dynamic":
+        # re-roll ALL users then index the selected ones (ref fed.py:15-24 +
+        # distribute) -- also keeps the PRNG stream identical to the masked
+        # round engine's in-jit draw for any selection.
         rates = jnp.asarray(cfg["model_rate"], jnp.float32)
-        idx = jax.random.choice(key, len(rates), shape=user_idx.shape, p=jnp.asarray(cfg["proportion"]))
-        return rates[idx]
+        idx = jax.random.choice(key, len(rates), shape=(cfg["num_users"],),
+                                p=jnp.asarray(cfg["proportion"]))
+        return rates[idx][user_idx]
     raise ValueError("Not valid model split mode")
 
 

@@ -34,24 +34,35 @@ MODEL_NAMES = ("conv",) + tuple(RESNET_BLOCKS) + ("transformer",)
 
 
 def make_model(cfg: Dict[str, Any], model_rate: Optional[float] = None) -> ModelDef:
+    import jax.numpy as jnp
+
     name = cfg["model_name"]
     if model_rate is None:
         model_rate = cfg["global_model_rate"]
     scaler_rate = model_rate / cfg["global_model_rate"]
+    cd = cfg.get("compute_dtype")
+    if cd in ("bfloat16", "bf16"):
+        compute_dtype = jnp.bfloat16
+    elif cd in (None, "float32", "f32", "fp32"):
+        compute_dtype = None
+    else:
+        raise ValueError(f"Not valid compute_dtype: {cd!r} (float32 | bfloat16)")
     if name == "conv":
         model = make_conv(cfg["data_shape"], scaled_hidden(cfg["conv"]["hidden_size"], model_rate),
-                          cfg["classes_size"], norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"])
+                          cfg["classes_size"], norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"],
+                          compute_dtype=compute_dtype)
     elif name in RESNET_BLOCKS:
         num_blocks, bottleneck = RESNET_BLOCKS[name]
         model = make_resnet(cfg["data_shape"], scaled_hidden(cfg["resnet"]["hidden_size"], model_rate),
                             num_blocks, cfg["classes_size"], bottleneck=bottleneck,
-                            norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"])
+                            norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"],
+                            compute_dtype=compute_dtype)
     elif name == "transformer":
         t = cfg["transformer"]
         model = make_transformer(
             cfg["num_tokens"], ceil_width(t["embedding_size"], model_rate), t["num_heads"],
             ceil_width(t["hidden_size"], model_rate), t["num_layers"], t["dropout"],
-            cfg["bptt"], cfg["mask_rate"], mask=cfg["mask"])
+            cfg["bptt"], cfg["mask_rate"], mask=cfg["mask"], compute_dtype=compute_dtype)
     else:
         raise ValueError("Not valid model name")
     model.meta["model_rate"] = model_rate

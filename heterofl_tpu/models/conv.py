@@ -22,7 +22,7 @@ from .spec import Group, ParamSpec
 
 
 def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
-              scale: bool = True, mask: bool = True) -> ModelDef:
+              scale: bool = True, mask: bool = True, compute_dtype=None) -> ModelDef:
     """Build the CNN at the given (global) widths.
 
     ``hidden_size`` are the *constructed* widths: the global model passes
@@ -67,7 +67,8 @@ def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
         x = batch["img"]
         collected = {}
         for i in range(n_blocks):
-            x = conv2d(x, params[f"block{i}.conv.w"], params[f"block{i}.conv.b"])
+            x = conv2d(x, params[f"block{i}.conv.w"], params[f"block{i}.conv.b"],
+                       compute_dtype=compute_dtype)
             if scale:
                 x = scaler(x, scaler_rate, train)
             g = groups[f"h{i}"]
@@ -83,7 +84,7 @@ def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
             if i < n_blocks - 1:  # last pool dropped (ref conv.py:56)
                 x = max_pool2(x)
         x = global_avg_pool(x)
-        out = linear(x, params["linear.w"], params["linear.b"])
+        out = linear(x, params["linear.w"], params["linear.b"], compute_dtype=compute_dtype)
         out = masked_logits(out, label_mask, mask)
         loss = cross_entropy(out, batch["label"], sample_weight)
         return {"score": out, "loss": loss}, collected

@@ -19,7 +19,9 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.layers import conv2d, cross_entropy, global_avg_pool, linear, masked_logits, scaler
+from functools import partial
+
+from ..ops.layers import conv2d as _conv2d, cross_entropy, global_avg_pool, linear as _linear, masked_logits, scaler
 from .base import ModelDef, uniform_fan_in
 from .norms import apply_norm, norm_has_params, norm_init
 from .spec import Group, ParamSpec
@@ -27,7 +29,7 @@ from .spec import Group, ParamSpec
 
 def make_resnet(data_shape, hidden_size, num_blocks: List[int], classes_size: int, *,
                 bottleneck: bool = False, norm: str = "bn", scale: bool = True,
-                mask: bool = True) -> ModelDef:
+                mask: bool = True, compute_dtype=None) -> ModelDef:
     in_ch = data_shape[-1]
     expansion = 4 if bottleneck else 1
     n_stages = len(hidden_size)
@@ -110,6 +112,9 @@ def make_resnet(data_shape, hidden_size, num_blocks: List[int], classes_size: in
         params["linear.w"] = uniform_fan_in(next(keys), (final_size, classes_size), final_size)
         params["linear.b"] = jnp.zeros(classes_size, jnp.float32)
         return params
+
+    conv2d = partial(_conv2d, compute_dtype=compute_dtype)
+    linear = partial(_linear, compute_dtype=compute_dtype)
 
     def apply(params, batch, *, train: bool, width_rate=1.0, scaler_rate=1.0,
               label_mask: Optional[jnp.ndarray] = None, bn_mode: str = "batch",

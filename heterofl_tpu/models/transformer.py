@@ -34,7 +34,7 @@ from .spec import Group, ParamSpec
 
 def make_transformer(num_tokens: int, embedding_size: int, num_heads: int,
                      hidden_size: int, num_layers: int, dropout: float, bptt: int,
-                     mask_rate: float, *, mask: bool = True) -> ModelDef:
+                     mask_rate: float, *, mask: bool = True, compute_dtype=None) -> ModelDef:
     E, H, F = embedding_size, num_heads, hidden_size
 
     groups = {
@@ -95,7 +95,8 @@ def make_transformer(num_tokens: int, embedding_size: int, num_heads: int,
         params["dec.l2.b"] = jnp.zeros(num_tokens)
         return params
 
-    apply = _make_apply(num_tokens, E, H, F, num_layers, dropout, bptt, mask_rate, mask, groups, specs)
+    apply = _make_apply(num_tokens, E, H, F, num_layers, dropout, bptt, mask_rate, mask, groups, specs,
+                        compute_dtype=compute_dtype)
 
     meta = {"bn_sizes": {}, "kind": "transformer", "num_tokens": num_tokens,
             "embedding_size": E, "num_heads": H, "hidden_size": F,
@@ -104,7 +105,12 @@ def make_transformer(num_tokens: int, embedding_size: int, num_heads: int,
 
 
 def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, mask_flag,
-                groups, specs):
+                groups, specs, compute_dtype=None):
+    from functools import partial
+
+    from ..ops.layers import linear as _linear
+
+    linear = partial(_linear, compute_dtype=compute_dtype)
     head_dim = E // H
 
     def apply(params, batch, *, train: bool, width_rate=1.0, scaler_rate=1.0,
@@ -153,9 +159,13 @@ def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, 
             k = sc(linear(x, params[f"{p}.mha.k.w"], params[f"{p}.mha.k.b"]))
             v = sc(linear(x, params[f"{p}.mha.v.w"], params[f"{p}.mha.v.b"]))
             q, k, v = heads_split(q), heads_split(k), heads_split(v)
-            scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) / temp
+            if compute_dtype is not None:
+                q, k, v = (t.astype(compute_dtype) for t in (q, k, v))
+            scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32) / temp
             attn = jax.nn.softmax(scores, axis=-1)
-            o = jnp.einsum("nhqk,nhkd->nhqd", attn, v)
+            if compute_dtype is not None:
+                attn = attn.astype(compute_dtype)
+            o = jnp.einsum("nhqk,nhkd->nhqd", attn, v).astype(jnp.float32)
             o = o.transpose(0, 2, 1, 3).reshape(N, S, E)
             o = sc(linear(o, params[f"{p}.mha.o.w"], params[f"{p}.mha.o.b"]))
             x = ln(f"{p}.norm1", x + dropout(o))

@@ -23,21 +23,36 @@ from jax import lax
 
 
 def conv2d(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray] = None,
-           stride: int = 1, padding: int = 1) -> jnp.ndarray:
-    """3x3/1x1 convolution, NHWC x HWIO -> NHWC."""
+           stride: int = 1, padding: int = 1,
+           compute_dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+    """3x3/1x1 convolution, NHWC x HWIO -> NHWC.
+
+    ``compute_dtype`` (e.g. bfloat16) casts the MXU operands while
+    accumulating in float32 -- the TPU mixed-precision recipe; params stay
+    float32 outside the op.
+    """
+    if compute_dtype is not None:
+        x, w = x.astype(compute_dtype), w.astype(compute_dtype)
     y = lax.conv_general_dilated(
         x, w,
         window_strides=(stride, stride),
         padding=((padding, padding), (padding, padding)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
+    if compute_dtype is not None:
+        y = y.astype(jnp.float32)  # XLA:TPU accumulates bf16 convs in f32
     if b is not None:
         y = y + b
     return y
 
 
-def linear(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    y = x @ w
+def linear(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray] = None,
+           compute_dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+    if compute_dtype is not None:
+        x, w = x.astype(compute_dtype), w.astype(compute_dtype)
+        y = (x @ w).astype(jnp.float32)
+    else:
+        y = x @ w
     if b is not None:
         y = y + b
     return y

@@ -162,7 +162,7 @@ def test_sample_model_rates_fix_and_dynamic():
     r = sample_model_rates(jax.random.key(0), cfg)
     assert r.shape == (10,)
     assert np.allclose(np.asarray(r)[:5], 1.0) and np.allclose(np.asarray(r)[5:], 0.5)
-    cfg_d = small_cfg("conv", control="1_10_0.5_iid_dynamic_a1-e1_bn_1_1")
+    cfg_d = small_cfg("conv", control="1_1000_0.5_iid_dynamic_a1-e1_bn_1_1")
     draws = np.asarray(sample_model_rates(jax.random.key(1), cfg_d, jnp.arange(1000)))
     assert set(np.unique(draws).tolist()) <= {1.0, 0.0625}
     assert 0.35 < np.mean(draws == 1.0) < 0.65

@@ -103,3 +103,30 @@ def test_sample_weight_neutralises_padding():
     o4, _ = model.apply(params, b4, train=True)
     o6, _ = model.apply(params, {"img": img6, "label": lab6}, train=True, sample_weight=w)
     assert np.allclose(o4["loss"], o6["loss"], rtol=1e-5)
+
+
+def test_bf16_compute_dtype_close_to_f32():
+    """bfloat16 MXU operands with f32 accumulation stay close to the f32
+    forward, and masked zeros remain exactly zero."""
+    import jax
+
+    from heterofl_tpu.models.spec import mask_params
+
+    cfg = small_cfg("resnet18")
+    m32 = make_model(cfg)
+    cfg16 = dict(cfg)
+    cfg16["compute_dtype"] = "bfloat16"
+    m16 = make_model(cfg16)
+    params = m32.init(jax.random.key(0))
+    batch = vision_batch(cfg, n=4)
+    o32, _ = m32.apply(params, batch, train=True)
+    o16, _ = m16.apply(params, batch, train=True)
+    assert abs(float(o32["loss"]) - float(o16["loss"])) < 0.05
+    # masked suffix stays exactly zero through bf16 forward+grad
+    masked = mask_params(params, m16.specs, m16.groups, 0.25)
+    g = jax.grad(lambda p: m16.apply(p, batch, train=True, width_rate=0.25,
+                                     scaler_rate=0.25)[0]["loss"])(masked)
+    import numpy as np
+
+    tail = np.asarray(g["layer3.1.conv2.w"])[:, :, 4:, :]
+    assert np.all(tail == 0.0)
