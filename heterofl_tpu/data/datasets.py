@@ -378,7 +378,12 @@ def synthetic_vision(data_name: str, split: str, n: Optional[int] = None, seed: 
     stripe depend on the label so that models can actually learn from it."""
     shape = (28, 28, 1) if data_name in ("MNIST", "FashionMNIST", "EMNIST") else (32, 32, 3)
     if data_name == "EMNIST":
-        classes = _EMNIST_CLASSES.get(subset if subset in _EMNIST_CLASSES else "balanced", 47)
+        if subset in ("label", None, ""):
+            subset = "balanced"  # same mapping as _load_emnist
+        if subset not in _EMNIST_CLASSES:
+            raise ValueError(f"Not valid EMNIST subset: {subset!r} "
+                             f"(one of {sorted(_EMNIST_CLASSES)})")
+        classes = _EMNIST_CLASSES[subset]
     else:
         classes = {"CIFAR100": 100}.get(data_name, 10)
     if n is None:

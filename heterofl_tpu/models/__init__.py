@@ -33,20 +33,23 @@ RESNET_BLOCKS = {
 MODEL_NAMES = ("conv",) + tuple(RESNET_BLOCKS) + ("transformer",)
 
 
-def make_model(cfg: Dict[str, Any], model_rate: Optional[float] = None) -> ModelDef:
+def parse_compute_dtype(cd):
+    """cfg['compute_dtype'] -> jnp dtype or None, with validation."""
     import jax.numpy as jnp
 
+    if cd in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if cd in (None, "float32", "f32", "fp32"):
+        return None
+    raise ValueError(f"Not valid compute_dtype: {cd!r} (float32 | bfloat16)")
+
+
+def make_model(cfg: Dict[str, Any], model_rate: Optional[float] = None) -> ModelDef:
     name = cfg["model_name"]
     if model_rate is None:
         model_rate = cfg["global_model_rate"]
     scaler_rate = model_rate / cfg["global_model_rate"]
-    cd = cfg.get("compute_dtype")
-    if cd in ("bfloat16", "bf16"):
-        compute_dtype = jnp.bfloat16
-    elif cd in (None, "float32", "f32", "fp32"):
-        compute_dtype = None
-    else:
-        raise ValueError(f"Not valid compute_dtype: {cd!r} (float32 | bfloat16)")
+    compute_dtype = parse_compute_dtype(cfg.get("compute_dtype"))
     if name == "conv":
         model = make_conv(cfg["data_shape"], scaled_hidden(cfg["conv"]["hidden_size"], model_rate),
                           cfg["classes_size"], norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"],

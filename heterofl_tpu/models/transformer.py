@@ -22,19 +22,21 @@ identical (transformer.py:141-142) -- an artifact, not a feature.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
-from ..ops.layers import cross_entropy, embed, linear, masked_layer_norm, masked_logits, scaler
+from ..ops.layers import cross_entropy, embed, linear as _linear, masked_layer_norm, masked_logits, scaler
 from .base import ModelDef, normal_init, uniform_fan_in
 from .spec import Group, ParamSpec
 
 
 def make_transformer(num_tokens: int, embedding_size: int, num_heads: int,
                      hidden_size: int, num_layers: int, dropout: float, bptt: int,
-                     mask_rate: float, *, mask: bool = True, compute_dtype=None) -> ModelDef:
+                     mask_rate: float, *, mask: bool = True, compute_dtype=None,
+                     attn_impl=None, remat: bool = False) -> ModelDef:
     E, H, F = embedding_size, num_heads, hidden_size
 
     groups = {
@@ -96,7 +98,7 @@ def make_transformer(num_tokens: int, embedding_size: int, num_heads: int,
         return params
 
     apply = _make_apply(num_tokens, E, H, F, num_layers, dropout, bptt, mask_rate, mask, groups, specs,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype, attn_impl=attn_impl, remat=remat)
 
     meta = {"bn_sizes": {}, "kind": "transformer", "num_tokens": num_tokens,
             "embedding_size": E, "num_heads": H, "hidden_size": F,
@@ -105,11 +107,7 @@ def make_transformer(num_tokens: int, embedding_size: int, num_heads: int,
 
 
 def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, mask_flag,
-                groups, specs, compute_dtype=None):
-    from functools import partial
-
-    from ..ops.layers import linear as _linear
-
+                groups, specs, compute_dtype=None, attn_impl=None, remat=False):
     linear = partial(_linear, compute_dtype=compute_dtype)
     head_dim = E // H
 
@@ -123,15 +121,15 @@ def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, 
         k_emb = groups["emb"].active_count(width_rate).astype(jnp.float32)
         temp = jnp.sqrt(jnp.floor(k_emb / H))
 
-        n_drop = 1 + 3 * num_layers
-        keys = jax.random.split(rng, 1 + n_drop)
-        corrupt_key = keys[0]
-        drop_keys = iter(keys[1:])
+        corrupt_key = jax.random.fold_in(rng, 0)
+        # dropout keys are derived per site id (NOT an iterator) so remat's
+        # replay of a layer block regenerates identical masks
+        drop_base = jax.random.fold_in(rng, 1)
 
-        def dropout(x):
-            key = next(drop_keys)
+        def dropout(x, site: int):
             if not train or dropout_rate == 0.0:
                 return x
+            key = jax.random.fold_in(drop_base, site)
             keep = jax.random.bernoulli(key, 1.0 - dropout_rate, x.shape)
             return jnp.where(keep, x / (1.0 - dropout_rate), 0.0)
 
@@ -145,15 +143,17 @@ def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, 
         src_ids = jnp.where(corrupt, num_tokens, labels)
 
         # Embedding: scaler(tok) + scaler(pos), LayerNorm, dropout
-        # (ref transformer.py:34-37).
-        pos = params["embedding.pos.w"][:S]
+        # (ref transformer.py:34-37).  ``pos_offset`` supports sequence-
+        # sharded execution (each shard embeds its global positions).
+        off = batch.get("pos_offset", 0)
+        pos = jax.lax.dynamic_slice_in_dim(params["embedding.pos.w"], off, S, axis=0)
         x = sc(embed(params["embedding.tok.w"], src_ids)) + sc(pos)[None, :, :]
-        x = dropout(ln("embedding.norm", x))
+        x = dropout(ln("embedding.norm", x), 0)
 
         def heads_split(t):  # [N,S,E] -> [N,H,S,hd]
             return t.reshape(N, S, H, head_dim).transpose(0, 2, 1, 3)
 
-        for i in range(num_layers):
+        def layer_block(x, i):
             p = f"enc{i}"
             q = sc(linear(x, params[f"{p}.mha.q.w"], params[f"{p}.mha.q.b"]))
             k = sc(linear(x, params[f"{p}.mha.k.w"], params[f"{p}.mha.k.b"]))
@@ -161,18 +161,26 @@ def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, 
             q, k, v = heads_split(q), heads_split(k), heads_split(v)
             if compute_dtype is not None:
                 q, k, v = (t.astype(compute_dtype) for t in (q, k, v))
-            scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32) / temp
-            attn = jax.nn.softmax(scores, axis=-1)
-            if compute_dtype is not None:
-                attn = attn.astype(compute_dtype)
-            o = jnp.einsum("nhqk,nhkd->nhqd", attn, v).astype(jnp.float32)
+            if attn_impl is not None:
+                o = attn_impl(q, k, v, temp).astype(jnp.float32)
+            else:
+                scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32) / temp
+                attn = jax.nn.softmax(scores, axis=-1)
+                if compute_dtype is not None:
+                    attn = attn.astype(compute_dtype)
+                o = jnp.einsum("nhqk,nhkd->nhqd", attn, v).astype(jnp.float32)
             o = o.transpose(0, 2, 1, 3).reshape(N, S, E)
             o = sc(linear(o, params[f"{p}.mha.o.w"], params[f"{p}.mha.o.b"]))
-            x = ln(f"{p}.norm1", x + dropout(o))
+            x = ln(f"{p}.norm1", x + dropout(o, 1 + 3 * i))
             h = dropout(jax.nn.gelu(sc(linear(x, params[f"{p}.ff.l1.w"], params[f"{p}.ff.l1.b"])),
-                                    approximate=False))
+                                    approximate=False), 2 + 3 * i)
             h = sc(linear(h, params[f"{p}.ff.l2.w"], params[f"{p}.ff.l2.b"]))
-            x = ln(f"{p}.norm2", x + dropout(h))
+            x = ln(f"{p}.norm2", x + dropout(h, 3 + 3 * i))
+            return x
+
+        block = jax.checkpoint(layer_block, static_argnums=(1,)) if remat else layer_block
+        for i in range(num_layers):
+            x = block(x, i)
 
         # Decoder head (ref transformer.py:131-133).
         d = jax.nn.gelu(sc(linear(x, params["dec.l1.w"], params["dec.l1.b"])), approximate=False)

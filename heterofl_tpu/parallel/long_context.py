@@ -1,0 +1,116 @@
+"""Sequence-parallel (long-context) masked-LM training.
+
+Net-new vs. the reference (its sequence handling is a fixed bptt=64 window,
+SURVEY §5.7).  The sequence dimension is sharded over a mesh axis: every
+device embeds its own positions (``pos_offset``), attention is exact ring
+attention (K/V blocks rotate via ``ppermute``; see ring_attention.py), and
+encoder layers are rematerialised so activation memory stays O(S_local).
+
+The result: the same HeteroFL transformer scales to sequences ``n_seq`` times
+longer than a single device could hold, with only neighbour-exchange
+communication per layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..config import ceil_width
+from ..models.transformer import make_transformer
+from ..utils.optim import clip_by_global_norm, make_optimizer
+from .ring_attention import ring_attention
+from .round_engine import _shard_map
+
+
+class SeqParallelLM:
+    """Jitted forward/train-step programs for a sequence-sharded transformer.
+
+    ``cfg['bptt']`` is the FULL sequence length; it is sharded over the
+    ``data`` mesh axis (``bptt % n_seq == 0``).
+    """
+
+    def __init__(self, cfg: Dict[str, Any], mesh, model_rate: float = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_seq = mesh.shape["data"]
+        assert cfg["bptt"] % self.n_seq == 0, "bptt must divide the seq axis"
+        t = cfg["transformer"]
+        rate = model_rate if model_rate is not None else cfg["global_model_rate"]
+
+        def attn(q, k, v, temp):
+            return ring_attention(q, k, v, axis_name="data", axis_size=self.n_seq,
+                                  temperature=temp)
+
+        from ..models import parse_compute_dtype
+
+        self.model = make_transformer(
+            cfg["num_tokens"], ceil_width(t["embedding_size"], rate), t["num_heads"],
+            ceil_width(t["hidden_size"], rate), t["num_layers"], t["dropout"],
+            cfg["bptt"], cfg["mask_rate"], mask=cfg["mask"],
+            compute_dtype=parse_compute_dtype(cfg.get("compute_dtype")),
+            attn_impl=attn, remat=True)
+        self._opt_init, self._opt_update = make_optimizer(cfg)
+        self._fwd = None
+        self._step = None
+
+    def init(self, key):
+        return self.model.init(key)
+
+    def init_opt(self, params):
+        return self._opt_init(params)
+
+    def _body_common(self, params, labels, w, key, train):
+        s_local = labels.shape[1]
+        idx = jax.lax.axis_index("data")
+        batch = {"label": labels, "pos_offset": idx * s_local}
+        out, _ = self.model.apply(params, batch, train=train, sample_weight=w,
+                                  rng=jax.random.fold_in(key, idx))
+        n_loc = jnp.sum(w)
+        return out["loss"] * n_loc, n_loc
+
+    def forward(self, params, labels: jnp.ndarray, key, w=None):
+        """Global-mean masked-LM loss over a ``[N, S]`` batch, S sharded."""
+        if self._fwd is None:
+            def body(params, labels, w, key):
+                lsum, n_loc = self._body_common(params, labels, w, key, train=False)
+                lsum = jax.lax.psum(lsum, ("clients", "data"))
+                n = jax.lax.psum(n_loc, ("clients", "data"))
+                return lsum / jnp.maximum(n, 1e-6)
+
+            self._fwd = jax.jit(_shard_map(
+                body, self.mesh,
+                in_specs=(P(), P(None, "data"), P(None, "data"), P()),
+                out_specs=P()))
+        if w is None:
+            w = jnp.ones(labels.shape, jnp.float32)
+        return self._fwd(params, labels, w, key)
+
+    def train_step(self, params, opt, labels: jnp.ndarray, key, lr, w=None):
+        """One SGD step on a sequence-sharded batch; grads are psum'd."""
+        if self._step is None:
+            def body(params, opt, labels, w, key, lr):
+                def loss_fn(p):
+                    lsum, n_loc = self._body_common(p, labels, w, key, train=True)
+                    return lsum, n_loc
+
+                (lsum, n_loc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                n = jax.lax.psum(n_loc, ("clients", "data"))
+                lsum = jax.lax.psum(lsum, ("clients", "data"))
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, ("clients", "data")) / jnp.maximum(n, 1e-6), grads)
+                grads, _ = clip_by_global_norm(grads, 1.0)
+                params, opt = self._opt_update(params, grads, opt, lr)
+                return params, opt, lsum / jnp.maximum(n, 1e-6)
+
+            self._step = jax.jit(_shard_map(
+                body, self.mesh,
+                in_specs=(P(), P(), P(None, "data"), P(None, "data"), P(), P()),
+                out_specs=(P(), P(), P())))
+        if w is None:
+            w = jnp.ones(labels.shape, jnp.float32)
+        return self._step(params, opt, labels, w, key, jnp.asarray(lr, jnp.float32))

@@ -1,0 +1,54 @@
+"""Sequence-parallel transformer: sharded-loss parity with a single device
+and long-sequence training progress."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heterofl_tpu import config as C
+from heterofl_tpu.models import make_model
+from heterofl_tpu.parallel import make_mesh
+from heterofl_tpu.parallel.long_context import SeqParallelLM
+
+
+def _cfg(bptt=128):
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name("1_4_0.5_iid_fix_a1_bn_1_1")
+    cfg["data_name"] = "WikiText2"
+    cfg["model_name"] = "transformer"
+    cfg = C.process_control(cfg)
+    cfg["transformer"] = {"embedding_size": 32, "num_heads": 4, "hidden_size": 64,
+                          "num_layers": 2, "dropout": 0.0}
+    cfg["bptt"] = bptt
+    cfg["mask_rate"] = 0.0  # deterministic forward for the parity check
+    cfg["num_tokens"] = 60
+    cfg["classes_size"] = 60
+    return cfg
+
+
+def test_seq_parallel_forward_matches_dense():
+    cfg = _cfg(bptt=128)
+    mesh = make_mesh(1, 8)
+    sp = SeqParallelLM(cfg, mesh)
+    params = sp.init(jax.random.key(0))
+    labels = jnp.asarray(np.random.default_rng(0).integers(0, 60, (2, 128)))
+    loss_sp = float(sp.forward(params, labels, jax.random.key(1)))
+    dense = make_model(cfg)  # same arch, dense attention
+    out, _ = dense.apply(params, {"label": labels}, train=False, rng=jax.random.key(1))
+    assert abs(loss_sp - float(out["loss"])) < 2e-4, (loss_sp, float(out["loss"]))
+
+
+def test_seq_parallel_training_reduces_loss():
+    cfg = _cfg(bptt=256)
+    cfg["mask_rate"] = 0.15
+    mesh = make_mesh(2, 4)  # batch over 'clients', sequence over 'data'
+    sp = SeqParallelLM(cfg, mesh)
+    params = sp.init(jax.random.key(0))
+    opt = sp.init_opt(params)
+    rng = np.random.default_rng(1)
+    labels = jnp.asarray(rng.integers(0, 60, (4, 256)))
+    losses = []
+    for i in range(8):
+        params, opt, loss = sp.train_step(params, opt, labels, jax.random.key(i), 0.5)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
