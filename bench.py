@@ -15,22 +15,60 @@ virtual-CPU path (debug).
 """
 
 import json
+import multiprocessing
 import os
 import sys
 import time
 
-if os.environ.get("BENCH_CPU") == "1":
+
+def _force_cpu():
     for _v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
                "AXON_LOOPBACK_RELAY", "AXON_POOL_SVC_OVERRIDE"):
         os.environ.pop(_v, None)
     os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+
+def _probe_devices(q):
+    try:
+        import jax as _jax
+
+        q.put(len(_jax.devices()) > 0)
+    except Exception:
+        q.put(False)
+
+
+def _tpu_alive(timeout_s: int) -> bool:
+    """Probe TPU init in a subprocess: the tunnel can hang indefinitely on a
+    stale grant, and a bench that never prints is worse than a CPU bench."""
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_probe_devices, args=(q,), daemon=True)
+    p.start()
+    p.join(timeout_s)
+    if p.is_alive():
+        p.terminate()
+        return False
+    try:
+        return bool(q.get_nowait())
+    except Exception:
+        return False
 
 
 def main():
+    # Platform selection must run ONLY in the parent process: the spawn-probe
+    # child re-imports this module, so nothing below may execute at import.
+    if os.environ.get("BENCH_CPU") == "1":
+        _force_cpu()
+    elif not _tpu_alive(int(os.environ.get("BENCH_INIT_TIMEOUT", "240"))):
+        print("bench: TPU init unresponsive, falling back to CPU", file=sys.stderr)
+        _force_cpu()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     from heterofl_tpu import config as C
     from heterofl_tpu.data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
     from heterofl_tpu.models import make_model
@@ -50,8 +88,14 @@ def main():
     cfg = C.process_control(cfg)
 
     hidden = os.environ.get("BENCH_HIDDEN")
+    degraded = None
     if hidden:  # debug-only shrink, e.g. BENCH_HIDDEN=8,16,16,16
         cfg["resnet"] = {"hidden_size": [int(h) for h in hidden.split(",")]}
+    elif jax.devices()[0].platform == "cpu":
+        # full-width ResNet-18 takes >9 min to compile on CPU; keep the
+        # fallback line honest but finishable
+        cfg["resnet"] = {"hidden_size": [16, 32, 64, 128]}
+        degraded = "cpu-fallback-quarter-width"
 
     ds = fetch_dataset("CIFAR10", synthetic=True, seed=0,
                        synthetic_sizes={"train": n_train, "test": 1000})
@@ -94,7 +138,8 @@ def main():
         "vs_baseline": round(rps / 10.0, 4),
         "extra": {"round_sec": round(dt, 3), "compile_sec": round(compile_s, 1),
                   "devices": len(jax.devices()), "platform": jax.devices()[0].platform,
-                  "active_clients": n_active, "final_loss": round(loss, 4)},
+                  "active_clients": n_active, "final_loss": round(loss, 4),
+                  **({"degraded": degraded} if degraded else {})},
     }))
 
 

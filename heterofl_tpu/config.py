@@ -82,6 +82,8 @@ DEFAULT_CFG: Dict[str, Any] = {
     "data_dir": "./data",
     "output_dir": "./output",
     "synthetic": False,  # force synthetic data (offline/testing)
+    "client_failure_rate": 0.0,  # per-round client crash probability (fault injection)
+    "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
     # Applied LAST by process_control: per-key overrides of any derived field
     # (dict values merge shallowly). E.g. {"num_epochs": {"global": 2},

@@ -207,13 +207,20 @@ _EMNIST_CLASSES = {"byclass": 62, "bymerge": 47, "balanced": 47, "letters": 26,
                    "digits": 10, "mnist": 10}
 
 
-def _load_emnist(root: str, split: str, subset: str) -> Optional[ArrayDataset]:
-    """EMNIST idx files (ref src/datasets/mnist.py EMNIST subsets)."""
+def _emnist_subset(subset) -> str:
+    """Normalise + validate an EMNIST subset name (cfg default 'label' -- the
+    reference's target-key field -- maps to 'balanced')."""
     if subset in ("label", None, ""):
-        subset = "balanced"  # cfg default 'label' is the reference's target-key
+        return "balanced"
     if subset not in _EMNIST_CLASSES:
         raise ValueError(f"Not valid EMNIST subset: {subset!r} "
                          f"(one of {sorted(_EMNIST_CLASSES)})")
+    return subset
+
+
+def _load_emnist(root: str, split: str, subset: str) -> Optional[ArrayDataset]:
+    """EMNIST idx files (ref src/datasets/mnist.py EMNIST subsets)."""
+    subset = _emnist_subset(subset)
     img_p = _find(root, f"emnist-{subset}-{split}-images-idx3-ubyte")
     lbl_p = _find(root, f"emnist-{subset}-{split}-labels-idx1-ubyte")
     if img_p is None or lbl_p is None:
@@ -378,12 +385,7 @@ def synthetic_vision(data_name: str, split: str, n: Optional[int] = None, seed: 
     stripe depend on the label so that models can actually learn from it."""
     shape = (28, 28, 1) if data_name in ("MNIST", "FashionMNIST", "EMNIST") else (32, 32, 3)
     if data_name == "EMNIST":
-        if subset in ("label", None, ""):
-            subset = "balanced"  # same mapping as _load_emnist
-        if subset not in _EMNIST_CLASSES:
-            raise ValueError(f"Not valid EMNIST subset: {subset!r} "
-                             f"(one of {sorted(_EMNIST_CLASSES)})")
-        classes = _EMNIST_CLASSES[subset]
+        classes = _EMNIST_CLASSES[_emnist_subset(subset)]
     else:
         classes = {"CIFAR100": 100}.get(data_name, 10)
     if n is None:

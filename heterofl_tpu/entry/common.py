@@ -73,7 +73,15 @@ def cfg_from_args(args: argparse.Namespace) -> Dict[str, Any]:
         val = getattr(args, k, None)
         if val is None:
             continue
-        if v is None or isinstance(v, (dict, list)):
+        if v is None:
+            # None-default flags: JSON containers/null parse, anything else
+            # stays a raw string (paths like "123" must not become ints)
+            try:
+                parsed = json.loads(val)
+            except json.JSONDecodeError:
+                parsed = val
+            cfg[k] = parsed if isinstance(parsed, (dict, list, type(None))) else val
+        elif isinstance(v, (dict, list)):
             cfg[k] = json.loads(val)
         elif isinstance(v, bool):
             cfg[k] = bool(val)
@@ -204,6 +212,12 @@ class FedExperiment:
         user_idx = self.sample_users()
         key = jax.random.fold_in(self.host_key, epoch)
         t0 = time.time()
+        # first steady-state round actually executed (works under resume too)
+        profiling = (self.cfg.get("profile_dir") and self._first_round_done
+                     and not getattr(self, "_profiled", False))
+        if profiling:
+            self._profiled = True
+            jax.profiler.start_trace(self.cfg["profile_dir"])
         if self.sliced is not None:
             rates = np.asarray(sample_model_rates(jax.random.fold_in(key, 7), self.cfg,
                                                   jnp.asarray(user_idx)))
@@ -214,6 +228,9 @@ class FedExperiment:
         else:
             params, ms = self.engine.train_round(params, key, lr, user_idx, self.train_data)
             ms = {k: np.asarray(v) for k, v in ms.items()}
+        if profiling:
+            jax.block_until_ready(params)
+            jax.profiler.stop_trace()
         named = summarize_sums(ms, self.cfg["model_name"])
         logger.append(named, "train", n=float(ms["n"].sum()))
         # running ETA over steady-state rounds, parity with the reference's

@@ -195,10 +195,21 @@ class RoundEngine:
         num_users = cfg["num_users"]
         n_dev = mesh.shape["clients"]
 
+        failure_rate = float(cfg.get("client_failure_rate", 0.0) or 0.0)
+
         def body(params, key, lr, user_idx, *data):
             # user_idx: this device's slot of active users, -1 = padding
             a = user_idx.shape[0]
             valid = (user_idx >= 0).astype(jnp.float32)
+            if failure_rate > 0.0:
+                # net-new fault injection (the reference only models dropout
+                # implicitly via frac-sampling): a failed client trains but
+                # its update never reaches aggregation -- like a crash after
+                # local work. All-failed rounds degrade to the stale rule.
+                dev = jax.lax.axis_index("clients")
+                fkey = jax.random.fold_in(jax.random.fold_in(key, 98), dev)
+                alive = 1.0 - jax.random.bernoulli(fkey, failure_rate, (a,)).astype(jnp.float32)
+                valid = valid * alive
             uidx = jnp.maximum(user_idx, 0)
             if dynamic:
                 rates_all = jnp.asarray(cfg["model_rate"], jnp.float32)

@@ -149,3 +149,27 @@ def test_sbn_and_eval():
     lmu = np.ones((4, 10), np.float32)
     res = ev.eval_users(params, bn, xu, yu, wu, lmu)
     assert res["n"].shape == (4,) and np.all(res["n"] == 25.0)
+
+
+def test_client_failure_injection():
+    """Failed clients' updates never reach aggregation; an all-failed round
+    leaves the global model untouched (stale rule)."""
+    cfg, ds, data = _vision_setup()
+    cfg["client_failure_rate"] = 1.0
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    p_np = {k: np.asarray(v) for k, v in params.items()}
+    eng = RoundEngine(model, cfg, make_mesh(2, 1))
+    new, ms = eng.train_round(params, jax.random.key(0), 0.05, np.array([0, 1]), data)
+    for k in p_np:
+        np.testing.assert_array_equal(np.asarray(new[k]), p_np[k], err_msg=k)
+    assert float(np.asarray(ms["n"]).sum()) == 0.0
+    # partial failure still trains
+    cfg2 = dict(cfg)
+    cfg2["client_failure_rate"] = 0.5
+    eng2 = RoundEngine(model, cfg2, make_mesh(2, 1))
+    params2 = model.init(jax.random.key(0))
+    new2, ms2 = eng2.train_round(params2, jax.random.key(3), 0.05,
+                                 np.arange(8, dtype=np.int32), data)
+    n2 = np.asarray(ms2["n"])
+    assert 0 < (n2 > 0).sum() < 8  # some failed, some trained
