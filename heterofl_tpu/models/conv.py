@@ -63,7 +63,7 @@ def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
 
     def apply(params, batch, *, train: bool, width_rate=1.0, scaler_rate=1.0,
               label_mask: Optional[jnp.ndarray] = None, bn_mode: str = "batch",
-              bn_state=None, sample_weight=None, rng=None):
+              bn_state=None, sample_weight=None, rng=None, bn_axis=None):
         x = batch["img"]
         collected = {}
         for i in range(n_blocks):
@@ -77,7 +77,7 @@ def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
                 norm, x, params.get(f"{site}.g"), params.get(f"{site}.b"),
                 mask=g.mask(width_rate), k=g.active_count(width_rate),
                 bn_mode=bn_mode, bn_running=None if bn_state is None else bn_state.get(site),
-                sample_weight=sample_weight)
+                sample_weight=sample_weight, bn_axis=bn_axis)
             if st is not None:
                 collected[site] = st
             x = jax.nn.relu(x)

@@ -32,7 +32,8 @@ def apply_norm(norm_type: str, x: jnp.ndarray, g: Optional[jnp.ndarray],
                b: Optional[jnp.ndarray], *, mask: jnp.ndarray, k,
                bn_mode: str = "batch",
                bn_running: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
-               sample_weight: Optional[jnp.ndarray] = None):
+               sample_weight: Optional[jnp.ndarray] = None,
+               bn_axis=None):
     """Apply one norm site. Returns ``(y, bn_stats_or_None)``.
 
     ``mask``/``k``: channel activity mask and active count for the client's
@@ -41,7 +42,8 @@ def apply_norm(norm_type: str, x: jnp.ndarray, g: Optional[jnp.ndarray],
     if norm_type == "none":
         return x, None
     if norm_type == "bn":
-        return batch_norm(x, g, b, mode=bn_mode, running=bn_running, sample_weight=sample_weight)
+        return batch_norm(x, g, b, mode=bn_mode, running=bn_running,
+                          sample_weight=sample_weight, axis_name=bn_axis)
     if norm_type == "in":
         # GroupNorm(C, C): per-sample per-channel stats over spatial dims.
         axes = tuple(range(1, x.ndim - 1))

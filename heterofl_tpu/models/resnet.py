@@ -118,7 +118,7 @@ def make_resnet(data_shape, hidden_size, num_blocks: List[int], classes_size: in
 
     def apply(params, batch, *, train: bool, width_rate=1.0, scaler_rate=1.0,
               label_mask: Optional[jnp.ndarray] = None, bn_mode: str = "batch",
-              bn_state=None, sample_weight=None, rng=None):
+              bn_state=None, sample_weight=None, rng=None, bn_axis=None):
         collected = {}
 
         def norm_site(site, x, group_name):
@@ -127,7 +127,7 @@ def make_resnet(data_shape, hidden_size, num_blocks: List[int], classes_size: in
                 norm, x, params.get(f"{site}.g"), params.get(f"{site}.b"),
                 mask=g.mask(width_rate), k=g.active_count(width_rate),
                 bn_mode=bn_mode, bn_running=None if bn_state is None else bn_state.get(site),
-                sample_weight=sample_weight)
+                sample_weight=sample_weight, bn_axis=bn_axis)
             if st is not None:
                 collected[site] = st
             return y

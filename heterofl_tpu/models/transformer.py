@@ -113,7 +113,7 @@ def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, 
 
     def apply(params, batch, *, train: bool, width_rate=1.0, scaler_rate=1.0,
               label_mask=None, bn_mode: str = "batch", bn_state=None,
-              sample_weight=None, rng=None):
+              sample_weight=None, rng=None, bn_axis=None):
         assert rng is not None, "transformer apply needs an rng (token corruption)"
         labels = batch["label"]
         N, S = labels.shape

@@ -101,7 +101,8 @@ def batch_norm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray, *,
                mode: str = "batch",
                running: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
                sample_weight: Optional[jnp.ndarray] = None,
-               eps: float = 1e-5):
+               eps: float = 1e-5,
+               axis_name=None):
     """Static batch norm (momentum=None, per-channel) for NHWC or NC inputs.
 
     Parity: ``nn.BatchNorm2d(C, momentum=None, track_running_stats=track)``
@@ -119,6 +120,11 @@ def batch_norm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray, *,
     pollute the statistics (the reference's final partial batch has exact
     semantics; we pad + mask instead).
 
+    ``axis_name``: synchronised BN -- batch statistics are reduced with
+    ``psum`` across that mesh axis, so a batch sharded over devices sees
+    exactly the full-batch statistics (needed for intra-client batch DP to be
+    numerically identical to single-device execution).
+
     Per-channel statistics mean masked-out channels are exactly equivalent to
     the sliced sub-model's BN for the active channels.
     """
@@ -131,7 +137,27 @@ def batch_norm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray, *,
     if sample_weight is not None:
         w = sample_weight.reshape((-1,) + (1,) * (x.ndim - 1))
         w = jnp.broadcast_to(w, x.shape)
-    mean, var, n = _weighted_moments(x, axes, w)
+    if axis_name is not None:
+        # cross-device moments via (sum, sumsq, count) psums
+        if w is None:
+            s1 = jnp.sum(x, axis=axes, keepdims=True)
+            s2 = jnp.sum(x * x, axis=axes, keepdims=True)
+            cnt = 1.0
+            for a in axes:
+                cnt *= x.shape[a]
+            n = jnp.asarray(cnt, x.dtype)
+        else:
+            s1 = jnp.sum(x * w, axis=axes, keepdims=True)
+            s2 = jnp.sum(w * x * x, axis=axes, keepdims=True)
+            n = jnp.sum(w, axis=axes, keepdims=True)
+        s1 = jax.lax.psum(s1, axis_name)
+        s2 = jax.lax.psum(s2, axis_name)
+        n = jax.lax.psum(n, axis_name)
+        d = jnp.maximum(n, 1e-6)
+        mean = s1 / d
+        var = jnp.maximum(s2 / d - mean * mean, 0.0)
+    else:
+        mean, var, n = _weighted_moments(x, axes, w)
     y = (x - mean) / jnp.sqrt(var + eps) * g + b
     if mode == "collect":
         unbiased = var * n / jnp.maximum(n - 1, 1)

@@ -87,7 +87,16 @@ class RoundEngine:
             img = x_u8.astype(jnp.float32)
         return img
 
-    def _local_train_vision(self, params, wr, x, y, sm, lm, key, lr, scaler_rate=None):
+    def _local_train_vision(self, params, wr, x, y, sm, lm, key, lr, scaler_rate=None,
+                            data_axis=None, n_data: int = 1):
+        """Local SGD for one client.
+
+        ``data_axis``/``n_data``: intra-client batch data-parallelism -- each
+        device on that mesh axis processes ``B/n_data`` of every batch,
+        gradients/metrics are ``psum``-ed and BN runs synchronised, so the
+        result is numerically identical to single-device execution (modulo
+        augmentation RNG).  Callers outside ``shard_map`` pass ``None``.
+        """
         model, B, E = self.model, self.batch_size, self.local_epochs
         N = x.shape[0]
         S = _ceil_div(N, B)
@@ -114,32 +123,54 @@ class RoundEngine:
         else:
             wpad = jnp.ones(SB, jnp.float32)
 
+        b_loc = _ceil_div(B, n_data)
+        bp = b_loc * n_data
+
         def step(carry, t):
             p, opt, acc = carry
             e, s = t // S, t % S
             ids = jax.lax.dynamic_slice(perms, (e, s * B), (1, B))[0]
             w = jax.lax.dynamic_slice(wpad, (s * B,), (B,)) * sm[ids]
-            img = self._prep_vision_batch(x[ids], w, jax.random.fold_in(key, 2 + t))
+            has = (jnp.sum(w) > 0)  # global batch weight BEFORE any sharding
+            n_glob = jnp.sum(w)
+            if data_axis is not None and n_data > 1:
+                # this device's slice of the client's batch
+                d = jax.lax.axis_index(data_axis)
+                ids = jnp.concatenate([ids, ids[: bp - B]]) if bp > B else ids
+                w = jnp.concatenate([w, jnp.zeros(bp - B, jnp.float32)]) if bp > B else w
+                ids = jax.lax.dynamic_slice(ids, (d * b_loc,), (b_loc,))
+                w = jax.lax.dynamic_slice(w, (d * b_loc,), (b_loc,))
+            aug_key = jax.random.fold_in(key, 2 + t)
+            if data_axis is not None and n_data > 1:
+                # decorrelate augmentation across batch slices
+                aug_key = jax.random.fold_in(aug_key, jax.lax.axis_index(data_axis))
+            img = self._prep_vision_batch(x[ids], w, aug_key)
             batch = {"img": img, "label": y[ids]}
 
             def loss_fn(p):
                 out, _ = model.apply(p, batch, train=True, width_rate=wr, scaler_rate=sr,
                                      label_mask=lm, sample_weight=w,
-                                     rng=jax.random.fold_in(key, 5000 + t))
-                return out["loss"], out["score"]
+                                     rng=jax.random.fold_in(key, 5000 + t),
+                                     bn_axis=data_axis if n_data > 1 else None)
+                n_loc = jnp.sum(w)
+                # weighted-SUM form so cross-device reduction recovers the
+                # exact full-batch mean gradient
+                return out["loss"] * n_loc, out["score"]
 
-            (loss, score), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            (lsum, score), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            correct = jnp.sum((jnp.argmax(score, -1) == y[ids]) * w)
+            if data_axis is not None and n_data > 1:
+                grads, lsum, correct = jax.lax.psum((grads, lsum, correct), data_axis)
+            grads = {k: g / jnp.maximum(n_glob, 1e-6) for k, g in grads.items()}
+            loss = lsum / jnp.maximum(n_glob, 1e-6)
             grads = {k: g * param_mask(g.shape, model.specs[k], model.groups, wr)
                      for k, g in grads.items()}
             grads, _ = clip_by_global_norm(grads, 1.0)
             p_new, opt_new = self._opt_update(p, grads, opt, lr)
             # all-padding batch: skip the step entirely (no wd/momentum drift)
-            has = (jnp.sum(w) > 0)
             p = jax.tree_util.tree_map(lambda a, b: jnp.where(has, a, b), p_new, p)
             opt = jax.tree_util.tree_map(lambda a, b: jnp.where(has, a, b), opt_new, opt)
-            n = jnp.sum(w)
-            correct = jnp.sum((jnp.argmax(score, -1) == y[ids]) * w)
-            acc = (acc[0] + loss * n, acc[1] + correct, acc[2] + n)
+            acc = (acc[0] + loss * n_glob, acc[1] + correct, acc[2] + n_glob)
             return (p, opt, acc), None
 
         acc0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
@@ -191,6 +222,13 @@ class RoundEngine:
     def _build_train(self):
         model, cfg = self.model, self.cfg
         mesh = self.mesh
+        if self.is_lm and mesh.shape["data"] > 1:
+            import warnings
+
+            warnings.warn(
+                "transformer federated rounds replicate (not shard) over the "
+                "'data' mesh axis; use a clients-only mesh, or SeqParallelLM "
+                "for sequence parallelism", stacklevel=2)
         dynamic = cfg["model_split_mode"] == "dynamic"
         num_users = cfg["num_users"]
         n_dev = mesh.shape["clients"]
@@ -232,9 +270,11 @@ class RoundEngine:
             else:
                 all_x, all_y, all_m, all_lm = data[0], data[1], data[2], data[3]
                 xs, ys, sms, lm = all_x[uidx], all_y[uidx], all_m[uidx], all_lm[uidx]
+                n_data = mesh.shape["data"]
                 trained, ms = jax.vmap(
                     lambda w_, x_, y_, m_, l_, k_: self._local_train_vision(
-                        params, w_, x_, y_, m_, l_, k_, lr)
+                        params, w_, x_, y_, m_, l_, k_, lr,
+                        data_axis="data" if n_data > 1 else None, n_data=n_data)
                 )(wr, xs, ys, sms, lm, slot_keys)
 
             shapes = {k: v.shape for k, v in params.items()}

@@ -173,3 +173,27 @@ def test_client_failure_injection():
                                  np.arange(8, dtype=np.int32), data)
     n2 = np.asarray(ms2["n"])
     assert 0 < (n2 > 0).sum() < 8  # some failed, some trained
+
+
+def test_data_parallel_axis_matches_single_device():
+    """Intra-client batch DP over the 'data' axis (psum'd grads + sync BN) is
+    numerically identical to running each client on one device: a (2,2) mesh
+    round equals a (4,1) mesh round with the same keys (MNIST: no augment)."""
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    user_idx = np.array([0, 2, 4, 6])
+
+    p1 = model.init(jax.random.key(0))
+    eng1 = RoundEngine(model, cfg, make_mesh(4, 1))
+    out1, ms1 = eng1.train_round(p1, jax.random.key(5), 0.05, user_idx, data)
+
+    p2 = model.init(jax.random.key(0))
+    eng2 = RoundEngine(model, cfg, make_mesh(2, 2))
+    out2, ms2 = eng2.train_round(p2, jax.random.key(5), 0.05, user_idx, data)
+
+    for k in out1:
+        np.testing.assert_allclose(np.asarray(out1[k]), np.asarray(out2[k]),
+                                   rtol=5e-3, atol=5e-5, err_msg=k)
+    np.testing.assert_allclose(np.asarray(ms1["loss_sum"]), np.asarray(ms2["loss_sum"]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(ms1["n"]), np.asarray(ms2["n"]))
