@@ -133,17 +133,16 @@ class RoundEngine:
             w = jax.lax.dynamic_slice(wpad, (s * B,), (B,)) * sm[ids]
             has = (jnp.sum(w) > 0)  # global batch weight BEFORE any sharding
             n_glob = jnp.sum(w)
+            aug_key = jax.random.fold_in(key, 2 + t)
             if data_axis is not None and n_data > 1:
-                # this device's slice of the client's batch
+                # this device's slice of the client's batch, with the
+                # augmentation key decorrelated across slices
                 d = jax.lax.axis_index(data_axis)
                 ids = jnp.concatenate([ids, ids[: bp - B]]) if bp > B else ids
                 w = jnp.concatenate([w, jnp.zeros(bp - B, jnp.float32)]) if bp > B else w
                 ids = jax.lax.dynamic_slice(ids, (d * b_loc,), (b_loc,))
                 w = jax.lax.dynamic_slice(w, (d * b_loc,), (b_loc,))
-            aug_key = jax.random.fold_in(key, 2 + t)
-            if data_axis is not None and n_data > 1:
-                # decorrelate augmentation across batch slices
-                aug_key = jax.random.fold_in(aug_key, jax.lax.axis_index(data_axis))
+                aug_key = jax.random.fold_in(aug_key, d)
             img = self._prep_vision_batch(x[ids], w, aug_key)
             batch = {"img": img, "label": y[ids]}
 
@@ -162,7 +161,6 @@ class RoundEngine:
             if data_axis is not None and n_data > 1:
                 grads, lsum, correct = jax.lax.psum((grads, lsum, correct), data_axis)
             grads = {k: g / jnp.maximum(n_glob, 1e-6) for k, g in grads.items()}
-            loss = lsum / jnp.maximum(n_glob, 1e-6)
             grads = {k: g * param_mask(g.shape, model.specs[k], model.groups, wr)
                      for k, g in grads.items()}
             grads, _ = clip_by_global_norm(grads, 1.0)
@@ -170,7 +168,7 @@ class RoundEngine:
             # all-padding batch: skip the step entirely (no wd/momentum drift)
             p = jax.tree_util.tree_map(lambda a, b: jnp.where(has, a, b), p_new, p)
             opt = jax.tree_util.tree_map(lambda a, b: jnp.where(has, a, b), opt_new, opt)
-            acc = (acc[0] + loss * n_glob, acc[1] + correct, acc[2] + n_glob)
+            acc = (acc[0] + lsum, acc[1] + correct, acc[2] + n_glob)
             return (p, opt, acc), None
 
         acc0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))

@@ -1,0 +1,240 @@
+"""Numerical parity against the ACTUAL reference implementation.
+
+Loads this framework's parameters into the reference's PyTorch models
+(mounted read-only at /root/reference -- imported, never copied) and compares
+forward outputs and losses on identical batches.  This pins the model
+semantics (conv/BN-sBN/Scaler/masked-CE, width-sliced sub-models) to the
+reference at the numerical level, not just by reimplementation reading.
+
+Skipped automatically when the reference tree or torch is unavailable.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+REF = "/root/reference/src"
+torch = pytest.importorskip("torch")
+if not os.path.isdir(REF):
+    pytest.skip("reference tree not mounted", allow_module_level=True)
+
+from heterofl_tpu import config as C  # noqa: E402
+from heterofl_tpu.fed import extract_sliced  # noqa: E402
+from heterofl_tpu.models import make_model  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ref_modules():
+    """Import the reference's modules, then remove /root/reference/src from
+    sys.path so its generic top-level names (config, models, ...) cannot
+    shadow anything for later-collected tests."""
+    cwd = os.getcwd()
+    os.chdir(REF)
+    sys.path.insert(0, REF)
+    try:
+        from config import cfg as ref_cfg  # noqa
+        import models as ref_models  # noqa
+    finally:
+        os.chdir(cwd)
+        sys.path.remove(REF)
+    return ref_cfg, ref_models
+
+
+def _my_cfg(norm="bn", hidden=(8, 16)):
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name(f"1_4_0.5_iid_fix_a1-b1_{norm}_1_1")
+    cfg["data_name"] = "MNIST"
+    cfg["model_name"] = "conv"
+    cfg = C.process_control(cfg)
+    cfg["conv"] = {"hidden_size": list(hidden)}
+    cfg["classes_size"] = 10
+    return cfg
+
+
+def _sync_ref_cfg(ref_cfg, my_cfg):
+    ref_cfg["norm"] = my_cfg["norm"]
+    ref_cfg["scale"] = my_cfg["scale"]
+    ref_cfg["mask"] = my_cfg["mask"]
+    ref_cfg["global_model_rate"] = my_cfg["global_model_rate"]
+    ref_cfg["classes_size"] = my_cfg["classes_size"]
+    ref_cfg["conv"] = dict(my_cfg["conv"])
+    ref_cfg["data_shape"] = [1, 28, 28]  # reference is CHW
+    ref_cfg["device"] = "cpu"
+
+
+def _to_torch_conv_state(params, n_blocks):
+    """My flat params -> the reference Conv's state_dict layout.
+
+    Reference blocks: [Conv2d, Scaler, Norm, ReLU, MaxPool] * n - last pool +
+    [AdaptiveAvgPool, Flatten, Linear] (ref models/conv.py:29-60).  Sequential
+    indices: conv_i at 5*i, norm at 5*i+2; Linear at 5*n + 1 (pool dropped on
+    the last block shifts tail indices by -1).
+    """
+    sd = {}
+    for i in range(n_blocks):
+        w = np.asarray(params[f"block{i}.conv.w"]).transpose(3, 2, 0, 1)  # HWIO->OIHW
+        sd[f"blocks.{5*i}.weight"] = torch.tensor(w.copy())
+        sd[f"blocks.{5*i}.bias"] = torch.tensor(np.asarray(params[f"block{i}.conv.b"]).copy())
+        if f"block{i}.norm.g" in params:
+            sd[f"blocks.{5*i+2}.weight"] = torch.tensor(np.asarray(params[f"block{i}.norm.g"]).copy())
+            sd[f"blocks.{5*i+2}.bias"] = torch.tensor(np.asarray(params[f"block{i}.norm.b"]).copy())
+    tail = 5 * n_blocks - 1 + 2  # dropped last pool, then avgpool+flatten
+    sd[f"blocks.{tail}.weight"] = torch.tensor(np.asarray(params["linear.w"]).T.copy())
+    sd[f"blocks.{tail}.bias"] = torch.tensor(np.asarray(params["linear.b"]).copy())
+    return sd
+
+
+@pytest.mark.parametrize("norm", ["bn", "in", "ln", "none"])
+def test_conv_forward_matches_reference(ref_modules, norm):
+    ref_cfg, ref_models = ref_modules
+    my_cfg = _my_cfg(norm=norm)
+    _sync_ref_cfg(ref_cfg, my_cfg)
+
+    model = make_model(my_cfg)
+    params = model.init(jax.random.key(0))
+
+    tm = ref_models.conv(model_rate=1.0)
+    missing = tm.load_state_dict(_to_torch_conv_state(params, 2), strict=True)
+    tm.train(True)
+
+    rng = np.random.default_rng(0)
+    img = rng.normal(size=(4, 28, 28, 1)).astype(np.float32)
+    label = rng.integers(0, 10, 4)
+    out_mine, _ = model.apply(params, {"img": jnp.asarray(img), "label": jnp.asarray(label)},
+                              train=True)
+    with torch.no_grad():
+        out_ref = tm({"img": torch.tensor(img.transpose(0, 3, 1, 2).copy()),
+                      "label": torch.tensor(label)})
+    np.testing.assert_allclose(np.asarray(out_mine["score"]),
+                               out_ref["score"].numpy(), rtol=2e-4, atol=2e-5)
+    assert abs(float(out_mine["loss"]) - float(out_ref["loss"])) < 2e-5
+
+
+def test_sliced_submodel_matches_reference_submodel(ref_modules):
+    """A rate-0.5 sub-model: my sliced params in the reference's rate-0.5
+    torch model == my masked full-width execution."""
+    ref_cfg, ref_models = ref_modules
+    my_cfg = _my_cfg(norm="bn")
+    _sync_ref_cfg(ref_cfg, my_cfg)
+
+    gm = make_model(my_cfg)
+    params = gm.init(jax.random.key(1))
+    rate = 0.5
+    sliced = extract_sliced({k: np.asarray(v) for k, v in params.items()},
+                            gm.specs, gm.groups, rate)
+
+    tm = ref_models.conv(model_rate=rate)
+    tm.load_state_dict(_to_torch_conv_state(sliced, 2), strict=True)
+    tm.train(True)
+
+    rng = np.random.default_rng(2)
+    img = rng.normal(size=(4, 28, 28, 1)).astype(np.float32)
+    label = rng.integers(0, 10, 4)
+    from heterofl_tpu.models.spec import mask_params
+
+    masked = mask_params(params, gm.specs, gm.groups, rate)
+    out_mine, _ = gm.apply(masked, {"img": jnp.asarray(img), "label": jnp.asarray(label)},
+                           train=True, width_rate=rate, scaler_rate=rate)
+    with torch.no_grad():
+        out_ref = tm({"img": torch.tensor(img.transpose(0, 3, 1, 2).copy()),
+                      "label": torch.tensor(label)})
+    np.testing.assert_allclose(np.asarray(out_mine["score"]),
+                               out_ref["score"].numpy(), rtol=2e-4, atol=2e-5)
+
+
+def test_label_mask_matches_reference(ref_modules):
+    ref_cfg, ref_models = ref_modules
+    my_cfg = _my_cfg(norm="none")
+    _sync_ref_cfg(ref_cfg, my_cfg)
+    model = make_model(my_cfg)
+    params = model.init(jax.random.key(3))
+    tm = ref_models.conv(model_rate=1.0)
+    tm.load_state_dict(_to_torch_conv_state(params, 2), strict=True)
+    tm.train(True)
+    rng = np.random.default_rng(4)
+    img = rng.normal(size=(3, 28, 28, 1)).astype(np.float32)
+    label = np.array([1, 3, 1])
+    lm = jnp.zeros(10).at[jnp.array([1, 3])].set(1.0)
+    out_mine, _ = model.apply(params, {"img": jnp.asarray(img), "label": jnp.asarray(label)},
+                              train=True, label_mask=lm)
+    with torch.no_grad():
+        out_ref = tm({"img": torch.tensor(img.transpose(0, 3, 1, 2).copy()),
+                      "label": torch.tensor(label),
+                      "label_split": torch.tensor([1, 3])})
+    np.testing.assert_allclose(np.asarray(out_mine["score"]),
+                               out_ref["score"].numpy(), rtol=2e-4, atol=2e-5)
+    assert abs(float(out_mine["loss"]) - float(out_ref["loss"])) < 2e-5
+
+
+def _to_torch_resnet_state(params):
+    """My flat resnet params -> reference ResNet state_dict names
+    (ref models/resnet.py: conv1, layer{1..4}.{b}.{n1,conv1,n2,conv2,shortcut},
+    n4, linear)."""
+    sd = {}
+
+    def cw(name):
+        return torch.tensor(np.asarray(params[name]).transpose(3, 2, 0, 1).copy())
+
+    sd["conv1.weight"] = cw("conv1.w")
+    for s in range(4):
+        for b in range(2):
+            mine = f"layer{s}.{b}"
+            ref = f"layer{s+1}.{b}"
+            for n in ("n1", "n2"):
+                if f"{mine}.{n}.g" in params:
+                    sd[f"{ref}.{n}.weight"] = torch.tensor(np.asarray(params[f"{mine}.{n}.g"]).copy())
+                    sd[f"{ref}.{n}.bias"] = torch.tensor(np.asarray(params[f"{mine}.{n}.b"]).copy())
+            sd[f"{ref}.conv1.weight"] = cw(f"{mine}.conv1.w")
+            sd[f"{ref}.conv2.weight"] = cw(f"{mine}.conv2.w")
+            if f"{mine}.shortcut.w" in params:
+                sd[f"{ref}.shortcut.weight"] = cw(f"{mine}.shortcut.w")
+    if "n4.g" in params:
+        sd["n4.weight"] = torch.tensor(np.asarray(params["n4.g"]).copy())
+        sd["n4.bias"] = torch.tensor(np.asarray(params["n4.b"]).copy())
+    sd["linear.weight"] = torch.tensor(np.asarray(params["linear.w"]).T.copy())
+    sd["linear.bias"] = torch.tensor(np.asarray(params["linear.b"]).copy())
+    return sd
+
+
+@pytest.mark.parametrize("rate", [1.0, 0.25])
+def test_resnet18_forward_matches_reference(ref_modules, rate):
+    ref_cfg, ref_models = ref_modules
+    my_cfg = _my_cfg(norm="bn")
+    my_cfg["model_name"] = "resnet18"
+    my_cfg["data_name"] = "CIFAR10"
+    my_cfg["resnet"] = {"hidden_size": [8, 16, 16, 32]}
+    my_cfg["data_shape"] = [32, 32, 3]
+    _sync_ref_cfg(ref_cfg, my_cfg)
+    ref_cfg["resnet"] = dict(my_cfg["resnet"])
+    ref_cfg["data_shape"] = [3, 32, 32]
+
+    gm = make_model(my_cfg)
+    params = gm.init(jax.random.key(5))
+    from heterofl_tpu.models.spec import mask_params
+
+    if rate == 1.0:
+        use = {k: np.asarray(v) for k, v in params.items()}
+    else:
+        use = extract_sliced({k: np.asarray(v) for k, v in params.items()},
+                             gm.specs, gm.groups, rate)
+    tm = ref_models.resnet18(model_rate=rate)
+    tm.load_state_dict(_to_torch_resnet_state(use), strict=True)
+    tm.train(True)
+
+    rng = np.random.default_rng(6)
+    img = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    label = rng.integers(0, 10, 4)
+    masked = mask_params(params, gm.specs, gm.groups, rate)
+    out_mine, _ = gm.apply(masked, {"img": jnp.asarray(img), "label": jnp.asarray(label)},
+                           train=True, width_rate=rate, scaler_rate=rate)
+    with torch.no_grad():
+        out_ref = tm({"img": torch.tensor(img.transpose(0, 3, 1, 2).copy()),
+                      "label": torch.tensor(label)})
+    np.testing.assert_allclose(np.asarray(out_mine["score"]),
+                               out_ref["score"].numpy(), rtol=5e-4, atol=5e-5)
+    assert abs(float(out_mine["loss"]) - float(out_ref["loss"])) < 5e-5
