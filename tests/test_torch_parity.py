@@ -99,7 +99,7 @@ def test_conv_forward_matches_reference(ref_modules, norm):
     params = model.init(jax.random.key(0))
 
     tm = ref_models.conv(model_rate=1.0)
-    missing = tm.load_state_dict(_to_torch_conv_state(params, 2), strict=True)
+    tm.load_state_dict(_to_torch_conv_state(params, 2), strict=True)
     tm.train(True)
 
     rng = np.random.default_rng(0)
@@ -236,5 +236,214 @@ def test_resnet18_forward_matches_reference(ref_modules, rate):
         out_ref = tm({"img": torch.tensor(img.transpose(0, 3, 1, 2).copy()),
                       "label": torch.tensor(label)})
     np.testing.assert_allclose(np.asarray(out_mine["score"]),
+                               out_ref["score"].numpy(), rtol=5e-4, atol=5e-5)
+    assert abs(float(out_mine["loss"]) - float(out_ref["loss"])) < 5e-5
+
+
+@pytest.fixture(scope="module")
+def ref_federation(ref_modules):
+    sys.path.insert(0, REF)
+    try:
+        from fed import Federation  # noqa
+    finally:
+        sys.path.remove(REF)
+    return Federation
+
+
+def test_distribute_matches_reference_federation(ref_modules, ref_federation):
+    """The reference's Federation.split_model/distribute applied to MY global
+    params produces exactly my extract_sliced sub-models (conv family)."""
+    ref_cfg, ref_models = ref_modules
+    my_cfg = _my_cfg(norm="bn")
+    _sync_ref_cfg(ref_cfg, my_cfg)
+    ref_cfg["model_name"] = "conv"
+    ref_cfg["model_split_mode"] = "fix"
+    ref_cfg["model_rate"] = [1.0, 0.5, 0.25, 0.125]
+
+    gm = make_model(my_cfg)
+    params = gm.init(jax.random.key(7))
+    sd = _to_torch_conv_state(params, 2)
+
+    fed = ref_federation(sd, ref_cfg["model_rate"], label_split={i: list(range(10)) for i in range(4)})
+    local_params, param_idx = fed.distribute([1, 2])  # users at rates 0.5, 0.25
+
+    pn = {k: np.asarray(v) for k, v in params.items()}
+    for m, rate in zip(range(2), (0.5, 0.25)):
+        mine = extract_sliced(pn, gm.specs, gm.groups, rate)
+        mine_sd = _to_torch_conv_state(mine, 2)
+        for k, v in local_params[m].items():
+            np.testing.assert_allclose(v.numpy(), mine_sd[k].numpy(), rtol=0, atol=0,
+                                       err_msg=f"user {m} rate {rate} param {k}")
+
+
+def test_combine_matches_reference_federation(ref_modules, ref_federation):
+    """The reference's counted-average combine and my masked-psum combine
+    produce the same new global params from identical client updates."""
+    ref_cfg, ref_models = ref_modules
+    my_cfg = _my_cfg(norm="bn")
+    _sync_ref_cfg(ref_cfg, my_cfg)
+    ref_cfg["model_name"] = "conv"
+    ref_cfg["model_split_mode"] = "fix"
+    ref_cfg["model_rate"] = [1.0, 0.5]
+
+    gm = make_model(my_cfg)
+    params = gm.init(jax.random.key(8))
+    pn = {k: np.asarray(v) for k, v in params.items()}
+    sd = {k: v.clone() for k, v in _to_torch_conv_state(params, 2).items()}
+    label_split = {0: [0, 1, 2, 3, 4], 1: [5, 6, 7, 8, 9]}
+
+    fed = ref_federation(sd, ref_cfg["model_rate"], label_split)
+    local_params, param_idx = fed.distribute([0, 1])
+    # fake "trained" updates: add deterministic noise to each client's params
+    rngs = [np.random.default_rng(10 + m) for m in range(2)]
+    for m in range(2):
+        for k in local_params[m]:
+            local_params[m][k] = local_params[m][k] + torch.tensor(
+                rngs[m].normal(size=tuple(local_params[m][k].shape)).astype(np.float32))
+    fed.combine(local_params, param_idx, [0, 1])
+    ref_new = {k: v.numpy() for k, v in fed.global_parameters.items()}
+
+    # my combine on the same updates (converted back to my layout)
+    from heterofl_tpu.fed import client_count_masks, combine_counted, embed_sliced
+    from heterofl_tpu.data import label_split_masks
+
+    lms = label_split_masks(label_split, 2, 10)
+    summed = {k: np.zeros_like(v) for k, v in pn.items()}
+    counts = {k: np.zeros_like(v, dtype=np.float32) for k, v in pn.items()}
+    for m, rate in zip(range(2), (1.0, 0.5)):
+        mine = extract_sliced(pn, gm.specs, gm.groups, rate)
+        rng_m = np.random.default_rng(10 + m)
+        # reproduce the torch-side noise in MY layout: iterate the SAME torch
+        # key order, then invert the layout transform
+        sdm = _to_torch_conv_state(mine, 2)
+        trained = {}
+        for k in local_params[m]:  # ordered like the torch state_dict
+            noise = rng_m.normal(size=tuple(sdm[k].shape)).astype(np.float32)
+            trained[k] = sdm[k].numpy() + noise
+        # torch layout -> my layout
+        mine_trained = {
+            "block0.conv.w": trained["blocks.0.weight"].transpose(2, 3, 1, 0),
+            "block0.conv.b": trained["blocks.0.bias"],
+            "block0.norm.g": trained["blocks.2.weight"],
+            "block0.norm.b": trained["blocks.2.bias"],
+            "block1.conv.w": trained["blocks.5.weight"].transpose(2, 3, 1, 0),
+            "block1.conv.b": trained["blocks.5.bias"],
+            "block1.norm.g": trained["blocks.7.weight"],
+            "block1.norm.b": trained["blocks.7.bias"],
+            "linear.w": trained["blocks.11.weight"].T,
+            "linear.b": trained["blocks.11.bias"],
+        }
+        back = embed_sliced(mine_trained, gm.specs, gm.groups, rate,
+                            {k: v.shape for k, v in pn.items()})
+        cm = {k: np.asarray(v) for k, v in client_count_masks(
+            {k: jnp.asarray(v) for k, v in pn.items()}, gm, rate,
+            jnp.asarray(lms[m])).items()}
+        for k in pn:
+            summed[k] += back[k] * cm[k]
+            counts[k] += cm[k]
+    my_new = combine_counted({k: jnp.asarray(v) for k, v in pn.items()},
+                             {k: jnp.asarray(v) for k, v in summed.items()},
+                             {k: jnp.asarray(v) for k, v in counts.items()})
+
+    my_new_sd = _to_torch_conv_state({k: np.asarray(v) for k, v in my_new.items()}, 2)
+    for k in ref_new:
+        np.testing.assert_allclose(ref_new[k], my_new_sd[k].numpy(), rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def _to_torch_transformer_state(params, num_layers):
+    """My flat transformer params -> reference Transformer state_dict
+    (ref models/transformer.py: transformer_embedding / transformer_encoder
+    .layers.{i}.{mha.linear_q..o, norm1, linear1, linear2, norm2} / decoder)."""
+    t = lambda a: torch.tensor(np.asarray(a).copy())
+    tT = lambda a: torch.tensor(np.asarray(a).T.copy())
+    sd = {
+        "transformer_embedding.embedding.weight": t(params["embedding.tok.w"]),
+        "transformer_embedding.positional_embedding.positional_embedding.weight":
+            t(params["embedding.pos.w"]),
+        "transformer_embedding.norm.weight": t(params["embedding.norm.g"]),
+        "transformer_embedding.norm.bias": t(params["embedding.norm.b"]),
+        "decoder.linear1.weight": tT(params["dec.l1.w"]),
+        "decoder.linear1.bias": t(params["dec.l1.b"]),
+        "decoder.norm1.weight": t(params["dec.norm.g"]),
+        "decoder.norm1.bias": t(params["dec.norm.b"]),
+        "decoder.linear2.weight": tT(params["dec.l2.w"]),
+        "decoder.linear2.bias": t(params["dec.l2.b"]),
+    }
+    for i in range(num_layers):
+        for mine, ref in (("q", "linear_q"), ("k", "linear_k"), ("v", "linear_v"),
+                          ("o", "linear_o")):
+            sd[f"transformer_encoder.layers.{i}.mha.{ref}.weight"] = tT(params[f"enc{i}.mha.{mine}.w"])
+            sd[f"transformer_encoder.layers.{i}.mha.{ref}.bias"] = t(params[f"enc{i}.mha.{mine}.b"])
+        for n in ("norm1", "norm2"):
+            sd[f"transformer_encoder.layers.{i}.{n}.weight"] = t(params[f"enc{i}.{n}.g"])
+            sd[f"transformer_encoder.layers.{i}.{n}.bias"] = t(params[f"enc{i}.{n}.b"])
+        sd[f"transformer_encoder.layers.{i}.linear1.weight"] = tT(params[f"enc{i}.ff.l1.w"])
+        sd[f"transformer_encoder.layers.{i}.linear1.bias"] = t(params[f"enc{i}.ff.l1.b"])
+        sd[f"transformer_encoder.layers.{i}.linear2.weight"] = tT(params[f"enc{i}.ff.l2.w"])
+        sd[f"transformer_encoder.layers.{i}.linear2.bias"] = t(params[f"enc{i}.ff.l2.b"])
+    return sd
+
+
+@pytest.mark.parametrize("rate", [1.0, 0.5])
+def test_transformer_forward_matches_reference(ref_modules, rate):
+    """Full transformer stack vs the reference's torch model, incl. the
+    per-head q/k/v sliced sub-model at rate 0.5 (corruption/dropout off for a
+    deterministic comparison)."""
+    ref_cfg, ref_models = ref_modules
+    my_cfg = C.default_cfg()
+    my_cfg["control"] = C.parse_control_name("1_4_0.5_iid_fix_a1-b1_bn_1_1")
+    my_cfg["data_name"] = "WikiText2"
+    my_cfg["model_name"] = "transformer"
+    my_cfg = C.process_control(my_cfg)
+    my_cfg["transformer"] = {"embedding_size": 32, "num_heads": 4, "hidden_size": 64,
+                             "num_layers": 2, "dropout": 0.0}
+    my_cfg["bptt"] = 16
+    my_cfg["mask_rate"] = 0.0
+    my_cfg["num_tokens"] = 50
+    my_cfg["classes_size"] = 50
+
+    ref_cfg["num_tokens"] = 50
+    ref_cfg["bptt"] = 16
+    ref_cfg["mask_rate"] = 0.0
+    ref_cfg["mask"] = True
+    ref_cfg["global_model_rate"] = 1.0
+    ref_cfg["transformer"] = dict(my_cfg["transformer"])
+
+    gm = make_model(my_cfg)
+    params = gm.init(jax.random.key(9))
+    pn = {k: np.asarray(v) for k, v in params.items()}
+    use = pn if rate == 1.0 else extract_sliced(pn, gm.specs, gm.groups, rate)
+
+    tm = ref_models.transformer(model_rate=rate)
+    tm.load_state_dict(_to_torch_transformer_state(use, 2), strict=True)
+    tm.train(True)
+
+    # The reference targets torch 1.7; modern nn.TransformerEncoder's
+    # fast-path probes layer.self_attn which its custom layer lacks.  Replace
+    # the encoder forward with the plain layer loop (identical semantics).
+    import types
+
+    def plain_forward(self, src, mask=None, src_key_padding_mask=None):
+        out = src
+        for mod in self.layers:
+            out = mod(out, src_mask=mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+    tm.transformer_encoder.forward = types.MethodType(plain_forward, tm.transformer_encoder)
+
+    rng = np.random.default_rng(11)
+    labels = rng.integers(0, 50, (2, 16))
+    from heterofl_tpu.models.spec import mask_params
+
+    masked = mask_params(params, gm.specs, gm.groups, rate)
+    out_mine, _ = gm.apply(masked, {"label": jnp.asarray(labels)}, train=True,
+                           width_rate=rate, scaler_rate=rate, rng=jax.random.key(0))
+    with torch.no_grad():
+        out_ref = tm({"label": torch.tensor(labels)})
+    # reference scores are [N, V, S]; mine are [N, S, V]
+    np.testing.assert_allclose(np.asarray(out_mine["score"]).transpose(0, 2, 1),
                                out_ref["score"].numpy(), rtol=5e-4, atol=5e-5)
     assert abs(float(out_mine["loss"]) - float(out_ref["loss"])) < 5e-5

@@ -123,3 +123,26 @@ def test_optimizer_sgd_matches_torch():
         opt.step()
         p, st = update(p, {"w": jnp.asarray(g)}, st, 0.1)
     np.testing.assert_allclose(np.asarray(p["w"]), tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_optimizer_rmsprop_adam_adamax_match_torch():
+    import torch
+
+    w0 = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    g = np.random.default_rng(1).normal(size=(4, 3)).astype(np.float32)
+    for name, mk in (("RMSprop", lambda p: torch.optim.RMSprop([p], lr=0.01, momentum=0.9,
+                                                               weight_decay=5e-4)),
+                     ("Adam", lambda p: torch.optim.Adam([p], lr=0.01, weight_decay=5e-4)),
+                     ("Adamax", lambda p: torch.optim.Adamax([p], lr=0.01, weight_decay=5e-4))):
+        tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+        topt = mk(tw)
+        cfg = {"optimizer_name": name, "momentum": 0.9, "weight_decay": 5e-4}
+        init, update = make_optimizer(cfg)
+        p = {"w": jnp.asarray(w0)}
+        st = init(p)
+        for _ in range(4):
+            tw.grad = torch.tensor(g.copy())
+            topt.step()
+            p, st = update(p, {"w": jnp.asarray(g)}, st, 0.01)
+        np.testing.assert_allclose(np.asarray(p["w"]), tw.detach().numpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
