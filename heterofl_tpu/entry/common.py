@@ -335,6 +335,9 @@ def run_main(description: str, model_default: str, data_default: str,
              pivot_metric: str, pivot_mode: str, argv: Optional[List[str]] = None):
     """Shared ``main()``: parse flags, loop seeds (ref
     train_classifier_fed.py:37-45), run experiments."""
+    from ..parallel.mesh import initialize_distributed
+
+    initialize_distributed()  # no-op single-host; joins the pod otherwise
     parser = build_cli(description)
     args = parser.parse_args(argv)
     cfg = cfg_from_args(args)

@@ -34,3 +34,28 @@ def make_mesh(n_clients: Optional[int] = None, n_data: int = 1,
         raise ValueError(f"need {need} devices, have {len(devices)}")
     arr = np.array(devices[:need]).reshape(n_clients, n_data)
     return Mesh(arr, ("clients", "data"))
+
+
+def initialize_distributed() -> bool:
+    """Multi-host bring-up: join the JAX distributed runtime when coordinator
+    env vars are present, so ``jax.devices()`` spans all hosts and
+    :func:`make_mesh` lays the ``clients``/``data`` axes over ICI within a
+    slice and DCN across slices (XLA routes collectives accordingly).
+
+    Reads the standard ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` variables (no-op when absent -- single-host runs and
+    TPU pod auto-detection need nothing).  Returns True if initialised.
+    """
+    import os
+
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return False
+    import jax as _jax
+
+    _jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]),
+    )
+    return True

@@ -165,3 +165,22 @@ def test_fetch_folder_dataset_missing_raises(tmp_path):
 
     with _pytest.raises(FileNotFoundError):
         fetch_dataset("Omniglot", data_dir=str(tmp_path))
+
+
+def test_lm_file_parsing(tmp_path):
+    """On-disk WikiText-format token files parse with train-built vocab and
+    <ukn> fallback for OOV test tokens (ref lm.py:202-219)."""
+    from heterofl_tpu.data.datasets import _load_lm, _VOCAB_CACHE
+
+    d = tmp_path / "WikiText2" / "wikitext-2"
+    os.makedirs(d)
+    (d / "wiki.train.tokens").write_text("the cat sat\nthe mat\n")
+    (d / "wiki.test.tokens").write_text("the dog sat\n")
+    _VOCAB_CACHE.clear()
+    tr = _load_lm(str(tmp_path / "WikiText2"), "train", "WikiText2")
+    te = _load_lm(str(tmp_path / "WikiText2"), "test", "WikiText2")
+    # vocab: <ukn>, <eos>, the, cat, sat, mat
+    assert len(tr.vocab) == 6
+    assert tr.token.tolist() == [2, 3, 4, 1, 2, 5, 1]  # the cat sat <eos> the mat <eos>
+    # 'dog' is OOV -> <ukn>=0
+    assert te.token.tolist() == [2, 0, 4, 1]
