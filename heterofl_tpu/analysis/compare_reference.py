@@ -1,0 +1,194 @@
+"""Accuracy-parity harness: federated training trajectories, this framework
+vs. the ACTUAL reference implementation, on identical data.
+
+The component-level parity suite (tests/test_torch_parity.py) pins models,
+slicing, aggregation and optimizers numerically; the only remaining
+divergence is host-side sampling RNG.  This harness closes the loop
+empirically: it runs the reference's own ``Federation`` + torch models
+(imported from the read-only mount) through the reference's round structure
+(distribute -> per-client torch SGD -> combine -> sBN recalibration -> test),
+and this framework's jitted round engine, on the SAME synthetic dataset and
+client splits, then reports both global-accuracy trajectories.
+
+Usage: ``python -m heterofl_tpu.analysis.compare_reference --rounds 10``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+REF = "/root/reference/src"
+
+
+def _import_reference():
+    cwd = os.getcwd()
+    os.chdir(REF)
+    sys.path.insert(0, REF)
+    try:
+        from config import cfg as ref_cfg  # noqa
+        import models as ref_models  # noqa
+        from fed import Federation  # noqa
+    finally:
+        os.chdir(cwd)
+        sys.path.remove(REF)
+    return ref_cfg, ref_models, Federation
+
+
+def _setup(seed: int, users: int, hidden, n_train: int, n_test: int):
+    from ..config import default_cfg, parse_control_name, process_control
+    from ..data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
+
+    cfg = default_cfg()
+    cfg["control"] = parse_control_name(f"1_{users}_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    cfg["data_name"] = "MNIST"
+    cfg["model_name"] = "conv"
+    cfg = process_control(cfg)
+    cfg["conv"] = {"hidden_size": list(hidden)}
+    cfg["num_epochs"] = {"global": 1, "local": 1}
+    cfg["batch_size"] = {"train": 10, "test": 50}
+    ds = fetch_dataset("MNIST", synthetic=True, seed=seed,
+                       synthetic_sizes={"train": n_train, "test": n_test})
+    cfg["classes_size"] = 10
+    rng = np.random.default_rng(seed)
+    split, lsplit = split_dataset(ds, users, "iid", rng, classes_size=10)
+    return cfg, ds, split, lsplit
+
+
+def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[float]:
+    """The reference's federated loop, driven by its own components."""
+    import torch
+
+    ref_cfg, ref_models, Federation = _import_reference()
+    ref_cfg.update({
+        "norm": "bn", "scale": True, "mask": True, "global_model_rate": 1.0,
+        "classes_size": 10, "conv": dict(cfg["conv"]), "data_shape": [1, 28, 28],
+        "device": "cpu", "model_name": "conv", "model_split_mode": "fix",
+        "model_rate": list(cfg["model_rate"]),
+    })
+    mean, std = 0.1307, 0.3081
+
+    def to_img(idx_list):
+        x = ds["train"].data[idx_list].astype(np.float32) / 255.0
+        x = (x - mean) / std
+        return torch.tensor(x.transpose(0, 3, 1, 2).copy())
+
+    torch.manual_seed(seed)
+    model = ref_models.conv(model_rate=1.0)
+    fed = Federation({k: v.clone() for k, v in model.state_dict().items()},
+                     list(cfg["model_rate"]), {i: lsplit[i] for i in lsplit})
+    rng = np.random.default_rng(seed + 77)       # user sampling: shared stream
+    shuffle_rng = np.random.default_rng(seed + 999)  # batch shuffles: private
+    users = cfg["num_users"]
+    n_active = int(np.ceil(cfg["frac"] * users))
+    accs = []
+    for r in range(rounds):
+        user_idx = rng.permutation(users)[:n_active].tolist()
+        local_params, param_idx = fed.distribute(user_idx)
+        for m, u in enumerate(user_idx):
+            rate = fed.model_rate[u]
+            tm = ref_models.conv(model_rate=float(rate))
+            tm.load_state_dict(local_params[m])
+            tm.train(True)
+            opt = torch.optim.SGD(tm.parameters(), lr=lr, momentum=0.9, weight_decay=5e-4)
+            idx = np.array(split["train"][u])
+            perm = shuffle_rng.permutation(len(idx))
+            B = cfg["batch_size"]["train"]
+            for s in range(0, len(idx), B):
+                batch_idx = idx[perm[s: s + B]]
+                inp = {"img": to_img(batch_idx),
+                       "label": torch.tensor(ds["train"].target[batch_idx]),
+                       "label_split": torch.tensor(lsplit[u])}
+                opt.zero_grad()
+                out = tm(inp)
+                out["loss"].backward()
+                torch.nn.utils.clip_grad_norm_(tm.parameters(), 1)
+                opt.step()
+            local_params[m] = tm.state_dict()
+        fed.combine(local_params, param_idx, user_idx)
+        # sBN recalibration with a fresh track=True model over the train set
+        with torch.no_grad():
+            test_model = ref_models.conv(model_rate=1.0, track=True)
+            test_model.load_state_dict(fed.global_parameters, strict=False)
+            test_model.train(True)
+            for s in range(0, len(ds["train"].data), 100):
+                sl = np.arange(s, min(s + 100, len(ds["train"].data)))
+                test_model({"img": to_img(sl), "label": torch.tensor(ds["train"].target[sl])})
+            test_model.train(False)
+            correct = 0
+            xt = ds["test"].data.astype(np.float32) / 255.0
+            xt = (xt - mean) / std
+            out = test_model({"img": torch.tensor(xt.transpose(0, 3, 1, 2).copy()),
+                              "label": torch.tensor(ds["test"].target)})
+            correct = (out["score"].argmax(1).numpy() == ds["test"].target).mean()
+        accs.append(float(correct * 100))
+    return accs
+
+
+def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[float]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..data import label_split_masks, stack_client_shards
+    from ..models import make_model
+    from ..parallel import RoundEngine, make_mesh
+    from ..parallel.evaluation import Evaluator
+    from ..entry.common import _batch_array
+
+    users = cfg["num_users"]
+    x, y, m = stack_client_shards(ds["train"].data, ds["train"].target, split["train"],
+                                  list(range(users)))
+    lm = label_split_masks(lsplit, users, 10)
+    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+    model = make_model(cfg)
+    params = model.init(jax.random.key(seed))
+    mesh = make_mesh(min(len(jax.devices()), users), 1)
+    eng = RoundEngine(model, cfg, mesh)
+    ev = Evaluator(model, cfg, mesh)
+    xb, wb = _batch_array(ds["train"].data, 100)
+    xg, wg = _batch_array(ds["test"].data, 100)
+    yg, _ = _batch_array(ds["test"].target, 100)
+    rng = np.random.default_rng(seed + 77)
+    n_active = int(np.ceil(cfg["frac"] * users))
+    accs = []
+    for r in range(rounds):
+        user_idx = rng.permutation(users)[:n_active].astype(np.int32)
+        params, _ = eng.train_round(params, jax.random.fold_in(jax.random.key(seed), r),
+                                    lr, user_idx, data)
+        bn = ev.sbn_stats(params, xb, wb)
+        g = ev.eval_global(params, bn, xg, yg, wg)
+        accs.append(100.0 * g["score_sum"] / max(g["n"], 1.0))
+    return accs
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="accuracy parity vs the reference")
+    parser.add_argument("--rounds", default=10, type=int)
+    parser.add_argument("--users", default=8, type=int)
+    parser.add_argument("--hidden", default="16,32", type=str)
+    parser.add_argument("--n_train", default=1600, type=int)
+    parser.add_argument("--n_test", default=400, type=int)
+    parser.add_argument("--lr", default=0.01, type=float)
+    parser.add_argument("--seed", default=0, type=int)
+    parser.add_argument("--out", default=None, type=str)
+    args = parser.parse_args(argv)
+    hidden = [int(h) for h in args.hidden.split(",")]
+    cfg, ds, split, lsplit = _setup(args.seed, args.users, hidden, args.n_train, args.n_test)
+    ref = run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+    mine = run_mine(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+    report = {"reference_acc": ref, "mine_acc": mine,
+              "final_gap_pp": round(mine[-1] - ref[-1], 2)}
+    print(json.dumps(report))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f)
+    return report
+
+
+if __name__ == "__main__":
+    main()

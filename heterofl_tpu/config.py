@@ -83,6 +83,7 @@ DEFAULT_CFG: Dict[str, Any] = {
     "output_dir": "./output",
     "synthetic": False,  # force synthetic data (offline/testing)
     "client_failure_rate": 0.0,  # per-round client crash probability (fault injection)
+    "eval_interval": 1,  # rounds between sBN+eval passes (1 = reference parity)
     "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
     # Applied LAST by process_control: per-key overrides of any derived field

@@ -297,16 +297,20 @@ class FedExperiment:
                 pivot = blob.get("pivot", pivot)
                 logger.history = blob.get("logger_history", logger.history)
         n_rounds = cfg["num_epochs"]["global"]
+        eval_interval = max(1, int(cfg.get("eval_interval", 1) or 1))
         for epoch in range(last_epoch, n_rounds + 1):
             logger.safe(True)
             lr = self.scheduler(epoch)
             params = self.train_round(params, epoch, lr, logger)
-            named_global = self.evaluate(params, epoch, logger, label_split)
-            if isinstance(self.scheduler, PlateauScheduler):
-                # min-mode plateau fed the test Global loss.  (The reference
-                # feeds logger.mean['train/Global-Accuracy'], a key its train
-                # loop never writes, i.e. a constant 0 -- an upstream bug we
-                # do not reproduce.)
+            evaluated = epoch % eval_interval == 0 or epoch == n_rounds
+            if evaluated:
+                self.evaluate(params, epoch, logger, label_split)
+            if isinstance(self.scheduler, PlateauScheduler) and evaluated:
+                # min-mode plateau fed the test Global loss, only on rounds
+                # that actually evaluated.  (The reference feeds
+                # logger.mean['train/Global-Accuracy'], a key its train loop
+                # never writes, i.e. a constant 0 -- an upstream bug we do
+                # not reproduce.)
                 self.scheduler.step_metric(logger.mean.get("test/Global-Loss", 0.0))
             logger.safe(False)
             cur = logger.history.get(f"test/{pivot_metric}", [None])[-1]

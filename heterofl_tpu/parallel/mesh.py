@@ -53,9 +53,7 @@ def initialize_distributed() -> bool:
         return False
     import jax as _jax
 
-    _jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
-        process_id=int(os.environ["JAX_PROCESS_ID"]),
-    )
+    # argless: jax reads JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    # JAX_PROCESS_ID / JAX_LOCAL_DEVICE_IDS itself, with its own diagnostics
+    _jax.distributed.initialize()
     return True
