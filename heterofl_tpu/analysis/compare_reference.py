@@ -40,16 +40,21 @@ def _import_reference():
     return ref_cfg, ref_models, Federation
 
 
-def _setup(seed: int, users: int, hidden, n_train: int, n_test: int):
+def _setup(seed: int, users: int, hidden, n_train: int, n_test: int,
+           model_name: str = "conv"):
     from ..config import default_cfg, parse_control_name, process_control
     from ..data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
 
     cfg = default_cfg()
     cfg["control"] = parse_control_name(f"1_{users}_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
     cfg["data_name"] = "MNIST"
-    cfg["model_name"] = "conv"
+    cfg["model_name"] = model_name
     cfg = process_control(cfg)
     cfg["conv"] = {"hidden_size": list(hidden)}
+    widths = list(hidden)
+    while len(widths) < 4:  # extend monotonically by doubling (resnet stages)
+        widths.append(widths[-1] * 2)
+    cfg["resnet"] = {"hidden_size": widths[:4]}
     cfg["num_epochs"] = {"global": 1, "local": 1}
     cfg["batch_size"] = {"train": 10, "test": 50}
     ds = fetch_dataset("MNIST", synthetic=True, seed=seed,
@@ -65,12 +70,15 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
     import torch
 
     ref_cfg, ref_models, Federation = _import_reference()
+    model_name = cfg["model_name"]
     ref_cfg.update({
         "norm": "bn", "scale": True, "mask": True, "global_model_rate": 1.0,
-        "classes_size": 10, "conv": dict(cfg["conv"]), "data_shape": [1, 28, 28],
-        "device": "cpu", "model_name": "conv", "model_split_mode": "fix",
+        "classes_size": 10, "conv": dict(cfg["conv"]), "resnet": dict(cfg["resnet"]),
+        "data_shape": [1, 28, 28],
+        "device": "cpu", "model_name": model_name, "model_split_mode": "fix",
         "model_rate": list(cfg["model_rate"]),
     })
+    factory = getattr(ref_models, model_name)
     mean, std = 0.1307, 0.3081
 
     def to_img(idx_list):
@@ -79,7 +87,7 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
         return torch.tensor(x.transpose(0, 3, 1, 2).copy())
 
     torch.manual_seed(seed)
-    model = ref_models.conv(model_rate=1.0)
+    model = factory(model_rate=1.0)
     fed = Federation({k: v.clone() for k, v in model.state_dict().items()},
                      list(cfg["model_rate"]), {i: lsplit[i] for i in lsplit})
     rng = np.random.default_rng(seed + 77)       # user sampling: shared stream
@@ -92,7 +100,7 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
         local_params, param_idx = fed.distribute(user_idx)
         for m, u in enumerate(user_idx):
             rate = fed.model_rate[u]
-            tm = ref_models.conv(model_rate=float(rate))
+            tm = factory(model_rate=float(rate))
             tm.load_state_dict(local_params[m])
             tm.train(True)
             opt = torch.optim.SGD(tm.parameters(), lr=lr, momentum=0.9, weight_decay=5e-4)
@@ -113,7 +121,7 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
         fed.combine(local_params, param_idx, user_idx)
         # sBN recalibration with a fresh track=True model over the train set
         with torch.no_grad():
-            test_model = ref_models.conv(model_rate=1.0, track=True)
+            test_model = factory(model_rate=1.0, track=True)
             test_model.load_state_dict(fed.global_parameters, strict=False)
             test_model.train(True)
             for s in range(0, len(ds["train"].data), 100):
@@ -176,9 +184,11 @@ def main(argv=None):
     parser.add_argument("--lr", default=0.01, type=float)
     parser.add_argument("--seed", default=0, type=int)
     parser.add_argument("--out", default=None, type=str)
+    parser.add_argument("--model", default="conv", type=str, choices=["conv", "resnet18"])
     args = parser.parse_args(argv)
     hidden = [int(h) for h in args.hidden.split(",")]
-    cfg, ds, split, lsplit = _setup(args.seed, args.users, hidden, args.n_train, args.n_test)
+    cfg, ds, split, lsplit = _setup(args.seed, args.users, hidden, args.n_train, args.n_test,
+                                    model_name=args.model)
     ref = run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
     mine = run_mine(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
     report = {"reference_acc": ref, "mine_acc": mine,
