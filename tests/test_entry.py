@@ -84,3 +84,18 @@ def test_train_classifier_central(tmp_path):
     assert len(hist["test/Accuracy"]) == 2
     out = test_classifier.main(argv)
     assert "Accuracy" in out[0]["metrics"]
+
+
+def test_train_transformer_central(tmp_path):
+    from heterofl_tpu.entry import train_transformer, test_transformer
+
+    argv = ["--control_name", "1_1_1_none_fix_a1_bn_1_1",
+            "--data_name", "WikiText2", "--model_name", "transformer"] + _override(
+        tmp_path, {"num_epochs": 2, "bptt": 16,
+                   "batch_size": {"train": 4, "test": 2}})
+    res = train_transformer.main(argv)
+    hist = res[0]["logger"].history
+    assert len(hist["test/Perplexity"]) == 2
+    assert np.isfinite(hist["test/Perplexity"]).all()
+    out = test_transformer.main(argv)
+    assert "Perplexity" in out[0]["metrics"]
