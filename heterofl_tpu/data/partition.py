@@ -51,6 +51,17 @@ def non_iid(dataset, num_users: int, rng: np.random.Generator,
     for i in range(len(label)):
         label_idx_split.setdefault(int(label[i]), []).append(i)
     shard_per_class = int(shard_per_user * num_users / classes_size)
+    # Same implicit constraints as the reference (data.py:90,101-103), which
+    # either crashes there with an opaque reshape error or silently floors the
+    # per-user shard count: shards must tile users and classes exactly.
+    if (shard_per_class < 1
+            or (shard_per_user * num_users) % classes_size != 0
+            or (classes_size * shard_per_class) % num_users != 0):
+        raise ValueError(
+            f"non-iid-{shard_per_user} needs shard_per_user*num_users to tile "
+            f"classes_size exactly (and classes*shards to tile users): got "
+            f"num_users={num_users}, classes_size={classes_size}; try "
+            f"num_users a multiple of {classes_size}")
     pools: Dict[int, List[np.ndarray]] = {}
     for label_i, label_idx in label_idx_split.items():
         num_leftover = len(label_idx) % shard_per_class
