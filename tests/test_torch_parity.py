@@ -172,30 +172,31 @@ def test_label_mask_matches_reference(ref_modules):
 
 
 def _to_torch_resnet_state(params):
-    """My flat resnet params -> reference ResNet state_dict names
-    (ref models/resnet.py: conv1, layer{1..4}.{b}.{n1,conv1,n2,conv2,shortcut},
-    n4, linear)."""
+    """My flat resnet params -> reference ResNet state_dict names, emitted in
+    the reference's module-definition ORDER (n1, conv1, n2, conv2, shortcut
+    per block): load_state_dict ignores order, but Federation.split_model's
+    index chaining depends on it (ref fed.py:63-103)."""
     sd = {}
 
     def cw(name):
         return torch.tensor(np.asarray(params[name]).transpose(3, 2, 0, 1).copy())
 
+    def nm(ref, mine):
+        if f"{mine}.g" in params:
+            sd[f"{ref}.weight"] = torch.tensor(np.asarray(params[f"{mine}.g"]).copy())
+            sd[f"{ref}.bias"] = torch.tensor(np.asarray(params[f"{mine}.b"]).copy())
+
     sd["conv1.weight"] = cw("conv1.w")
     for s in range(4):
         for b in range(2):
-            mine = f"layer{s}.{b}"
-            ref = f"layer{s+1}.{b}"
-            for n in ("n1", "n2"):
-                if f"{mine}.{n}.g" in params:
-                    sd[f"{ref}.{n}.weight"] = torch.tensor(np.asarray(params[f"{mine}.{n}.g"]).copy())
-                    sd[f"{ref}.{n}.bias"] = torch.tensor(np.asarray(params[f"{mine}.{n}.b"]).copy())
+            mine, ref = f"layer{s}.{b}", f"layer{s+1}.{b}"
+            nm(f"{ref}.n1", f"{mine}.n1")
             sd[f"{ref}.conv1.weight"] = cw(f"{mine}.conv1.w")
+            nm(f"{ref}.n2", f"{mine}.n2")
             sd[f"{ref}.conv2.weight"] = cw(f"{mine}.conv2.w")
             if f"{mine}.shortcut.w" in params:
                 sd[f"{ref}.shortcut.weight"] = cw(f"{mine}.shortcut.w")
-    if "n4.g" in params:
-        sd["n4.weight"] = torch.tensor(np.asarray(params["n4.g"]).copy())
-        sd["n4.bias"] = torch.tensor(np.asarray(params["n4.b"]).copy())
+    nm("n4", "n4")
     sd["linear.weight"] = torch.tensor(np.asarray(params["linear.w"]).T.copy())
     sd["linear.bias"] = torch.tensor(np.asarray(params["linear.b"]).copy())
     return sd
@@ -447,3 +448,88 @@ def test_transformer_forward_matches_reference(ref_modules, rate):
     np.testing.assert_allclose(np.asarray(out_mine["score"]).transpose(0, 2, 1),
                                out_ref["score"].numpy(), rtol=5e-4, atol=5e-5)
     assert abs(float(out_mine["loss"]) - float(out_ref["loss"])) < 5e-5
+
+
+@pytest.mark.parametrize("family", ["conv", "resnet18"])
+def test_full_round_matches_reference(ref_modules, family):
+    """A DETERMINISTIC full federated round vs the reference: one full-batch
+    SGD step per client (batch >= shard, local epochs 1, no augmentation)
+    removes every RNG dependence, so the reference's distribute -> torch SGD
+    -> combine must equal the jitted masked round parameter-for-parameter."""
+    from heterofl_tpu.data import label_split_masks
+    from heterofl_tpu.parallel import RoundEngine, make_mesh
+
+    ref_cfg, ref_models = ref_modules
+    sys.path.insert(0, REF)
+    try:
+        from fed import Federation
+    finally:
+        sys.path.remove(REF)
+
+    my_cfg = _my_cfg(norm="bn")
+    my_cfg["model_name"] = family
+    my_cfg["resnet"] = {"hidden_size": [4, 8, 8, 8]}
+    _sync_ref_cfg(ref_cfg, my_cfg)
+    ref_cfg["resnet"] = dict(my_cfg["resnet"])
+    ref_cfg["model_name"] = family
+    ref_cfg["model_split_mode"] = "fix"
+    rates = [1.0, 0.5, 0.25, 0.125]
+    ref_cfg["model_rate"] = rates
+    my_cfg["model_rate"] = rates
+    my_cfg["control"]["num_users"] = "4"
+    my_cfg["num_users"] = 4
+    my_cfg["num_epochs"] = {"global": 1, "local": 1}
+    N, B = 12, 16  # single full batch per client
+    my_cfg["batch_size"] = {"train": B, "test": B}
+    lr = 0.05
+
+    gm = make_model(my_cfg)
+    params = gm.init(jax.random.key(21))
+    pn = {k: np.asarray(v) for k, v in params.items()}
+    to_sd = (_to_torch_conv_state if family == "conv"
+             else _to_torch_resnet_state)
+
+    rng = np.random.default_rng(31)
+    xs = rng.normal(size=(4, N, 28, 28, 1)).astype(np.float32)
+    ys = rng.integers(0, 10, (4, N))
+    label_split = {i: sorted(set(ys[i].tolist())) for i in range(4)}
+
+    # ---- reference round
+    sd = to_sd(pn, 2) if family == "conv" else to_sd(pn)
+    fed = Federation({k: v.clone() for k, v in sd.items()}, rates, label_split)
+    local_params, param_idx = fed.distribute([0, 1, 2, 3])
+    factory = getattr(ref_models, family)
+    for m in range(4):
+        tm = factory(model_rate=rates[m])
+        tm.load_state_dict(local_params[m])
+        tm.train(True)
+        opt = torch.optim.SGD(tm.parameters(), lr=lr, momentum=0.9, weight_decay=5e-4)
+        inp = {"img": torch.tensor(xs[m].transpose(0, 3, 1, 2).copy()),
+               "label": torch.tensor(ys[m]),
+               "label_split": torch.tensor(label_split[m])}
+        opt.zero_grad()
+        out = tm(inp)
+        out["loss"].backward()
+        torch.nn.utils.clip_grad_norm_(tm.parameters(), 1)
+        opt.step()
+        local_params[m] = tm.state_dict()
+    fed.combine(local_params, param_idx, [0, 1, 2, 3])
+    ref_new = {k: v.numpy() for k, v in fed.global_parameters.items()}
+
+    # ---- my round. Neutralise normalisation exactly: the engine computes
+    # (stored/255 - mean)/std, so stored = 255*xs with mean 0, std 1 feeds the
+    # model precisely xs (scale tricks like std=1/255 are NOT safe: BN cancels
+    # input scale through conv+BN stacks, but ResNet's identity residuals
+    # don't -- which is how this test caught its own earlier bug).
+    my_cfg["norm_stats"] = ((0.0,), (1.0,))
+    eng = RoundEngine(gm, my_cfg, make_mesh(1, 1))
+    lm = label_split_masks(label_split, 4, 10)
+    data = (jnp.asarray((xs * 255.0).astype(np.float64)).astype(jnp.float32),
+            jnp.asarray(ys), jnp.ones((4, N), jnp.float32), jnp.asarray(lm))
+    new_params, _ = eng.train_round(params, jax.random.key(0), lr,
+                                    np.arange(4, dtype=np.int32), data)
+    mine = {k: np.asarray(v) for k, v in new_params.items()}
+    mine_sd = to_sd(mine, 2) if family == "conv" else to_sd(mine)
+    for k in ref_new:
+        np.testing.assert_allclose(ref_new[k], mine_sd[k].numpy(), rtol=2e-3, atol=2e-4,
+                                   err_msg=f"{family}: {k}")
