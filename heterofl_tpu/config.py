@@ -134,6 +134,11 @@ def _fix_rate_vector(mode_rate: List[float], proportion: List[int], num_users: i
     ``num_users // sum(proportion) * proportion_i`` users in level order, and
     any remainder is filled with the *last* (smallest) level's rate.
     """
+    if num_users < sum(proportion):
+        raise ValueError(
+            f"fix mode needs num_users >= sum of proportions: {num_users} users "
+            f"< {sum(proportion)} (the reference crashes with an opaque "
+            f"IndexError here); reduce the number of levels or add users")
     num_users_proportion = num_users // sum(proportion)
     model_rate: List[float] = []
     for i in range(len(mode_rate)):

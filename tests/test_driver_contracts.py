@@ -1,0 +1,40 @@
+"""The two driver-facing contracts: bench.py's single JSON line and
+__graft_entry__'s compile/dry-run hooks."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_schema_json():
+    env = dict(os.environ)
+    env.update({"BENCH_CPU": "1", "BENCH_USERS": "5", "BENCH_SYNTH_N": "100",
+                "BENCH_ROUNDS": "1", "BENCH_HIDDEN": "4,8,8,8",
+                "PYTHONPATH": REPO})
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "rounds/sec" and rec["value"] > 0
+    assert abs(rec["vs_baseline"] - rec["value"] / 10.0) < 1e-3  # both 4dp-rounded
+    assert np.isfinite(rec["extra"]["final_loss"])
+
+
+def test_graft_entry_contract():
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    loss, score = jax.jit(fn)(*args)
+    assert np.isfinite(float(loss))
+    assert score.shape[-1] == 10
+    g.dryrun_multichip(2)
+    g.dryrun_multichip(8)  # 2-D mesh path (4 clients x 2 data)
