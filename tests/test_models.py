@@ -130,3 +130,20 @@ def test_bf16_compute_dtype_close_to_f32():
 
     tail = np.asarray(g["layer3.1.conv2.w"])[:, :, 4:, :]
     assert np.all(tail == 0.0)
+
+
+def test_augment_cifar_shapes_and_determinism():
+    import jax
+
+    from heterofl_tpu.ops.augment import augment_cifar
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 255, (6, 32, 32, 3)), jnp.uint8)
+    a1 = augment_cifar(jax.random.key(3), x)
+    a2 = augment_cifar(jax.random.key(3), x)
+    a3 = augment_cifar(jax.random.key(4), x)
+    assert a1.shape == x.shape
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))  # same key
+    assert not np.array_equal(np.asarray(a1), np.asarray(a3))  # new key
+    # crop+flip only rearranges pixels from the padded canvas
+    assert np.asarray(a1).max() <= 255 and np.asarray(a1).min() >= 0
