@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Minimal TPU tunnel health probe: device init + one matmul, with timings.
+
+Run in background with `python -u`; never kill it mid-claim (stale grants wedge
+the single-client tunnel).
+"""
+import sys
+import time
+
+t0 = time.time()
+print(f"[{time.strftime('%H:%M:%S')}] importing jax...", flush=True)
+import jax
+import jax.numpy as jnp
+
+print(f"[{time.strftime('%H:%M:%S')}] jax {jax.__version__} imported "
+      f"({time.time()-t0:.1f}s); calling jax.devices()...", flush=True)
+t1 = time.time()
+devs = jax.devices()
+print(f"[{time.strftime('%H:%M:%S')}] devices ({time.time()-t1:.1f}s): "
+      f"{[(d.platform, d.device_kind) for d in devs]}", flush=True)
+t2 = time.time()
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print(f"[{time.strftime('%H:%M:%S')}] matmul ok ({time.time()-t2:.1f}s), "
+      f"sum={float(jnp.sum(y.astype(jnp.float32)))}", flush=True)
+print("PROBE_OK", flush=True)

@@ -27,6 +27,36 @@ def test_bench_emits_schema_json():
     assert np.isfinite(rec["extra"]["final_loss"])
 
 
+def test_bench_deadline_wedged_tpu_falls_back():
+    """A wedged TPU claim (simulated) must be killed at BENCH_TPU_TIMEOUT and
+    the CPU fallback must still print the one JSON line, rc 0."""
+    env = dict(os.environ)
+    env.update({"BENCH_FAKE_WEDGE": "1", "BENCH_TPU_TIMEOUT": "3",
+                "BENCH_DEADLINE": "400", "BENCH_USERS": "5",
+                "BENCH_SYNTH_N": "100", "BENCH_ROUNDS": "1",
+                "BENCH_HIDDEN": "4,8,8,8", "PYTHONPATH": REPO})
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0 and rec["extra"]["platform"] == "cpu"
+
+
+def test_bench_total_failure_still_prints_line():
+    """Even when the TPU wedges AND the fallback crashes, bench.py prints a
+    parseable record and exits 0 (the round-1 parsed:null failure mode)."""
+    env = dict(os.environ)
+    env.update({"BENCH_FAKE_WEDGE": "1", "BENCH_TPU_TIMEOUT": "3",
+                "BENCH_DEADLINE": "60", "BENCH_HIDDEN": "bogus",
+                "PYTHONPATH": REPO})
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
+    assert rec["value"] == 0.0 and "error" in rec["extra"]
+
+
 def test_graft_entry_contract():
     import jax
 
