@@ -118,8 +118,14 @@ def _supervise() -> int:
     # fallback (the full 120s reserve by default; an operator-set explicit
     # budget is honored down to a 45s reserve).  Skipped when too little time
     # remains for a meaningful attempt.
-    explicit = os.environ.get("BENCH_TPU_TIMEOUT")
-    tpu_budget = min(env_float("BENCH_TPU_TIMEOUT", deadline / 2),
+    raw = os.environ.get("BENCH_TPU_TIMEOUT")
+    try:
+        explicit_timeout = float(raw) if raw else None
+    except ValueError:
+        print(f"bench: ignoring malformed BENCH_TPU_TIMEOUT={raw!r}", file=sys.stderr)
+        explicit_timeout = None
+    explicit = explicit_timeout is not None
+    tpu_budget = min(explicit_timeout if explicit else deadline / 2,
                      remaining() - (45 if explicit else 120))
     if os.environ.get("BENCH_SKIP_TPU") == "1":
         print("bench: skipping TPU attempt (BENCH_SKIP_TPU=1)", file=sys.stderr)

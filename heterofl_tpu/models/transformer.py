@@ -113,18 +113,27 @@ def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, 
 
     def apply(params, batch, *, train: bool, width_rate=1.0, scaler_rate=1.0,
               label_mask=None, bn_mode: str = "batch", bn_state=None,
-              sample_weight=None, rng=None, bn_axis=None):
+              sample_weight=None, rng=None, bn_axis=None, attn_override=None):
         assert rng is not None, "transformer apply needs an rng (token corruption)"
         labels = batch["label"]
         N, S = labels.shape
+        # Sequence-sharded execution: ``pos_offset`` is this shard's global
+        # position and ``seq_full`` the full window length; corruption is
+        # drawn over the FULL window on every shard and sliced locally, so a
+        # sharded run corrupts exactly like an unsharded one.
+        off = batch.get("pos_offset", 0)
+        S_full = batch.get("seq_full", S)
         emb_mask = groups["emb"].mask(width_rate)
         k_emb = groups["emb"].active_count(width_rate).astype(jnp.float32)
         temp = jnp.sqrt(jnp.floor(k_emb / H))
 
         corrupt_key = jax.random.fold_in(rng, 0)
         # dropout keys are derived per site id (NOT an iterator) so remat's
-        # replay of a layer block regenerates identical masks
+        # replay of a layer block regenerates identical masks; shards of a
+        # sequence-sharded window are decorrelated via their position offset
         drop_base = jax.random.fold_in(rng, 1)
+        if S_full != S:
+            drop_base = jax.random.fold_in(drop_base, off)
 
         def dropout(x, site: int):
             if not train or dropout_rate == 0.0:
@@ -139,13 +148,14 @@ def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, 
         def ln(site, x):
             return masked_layer_norm(x, params[f"{site}.g"], params[f"{site}.b"], emb_mask, k_emb)
 
-        corrupt = jax.random.bernoulli(corrupt_key, mask_rate, (N, S))
+        corrupt = jax.random.bernoulli(corrupt_key, mask_rate, (N, S_full))
+        if S_full != S:
+            corrupt = jax.lax.dynamic_slice(corrupt, (0, off), (N, S))
         src_ids = jnp.where(corrupt, num_tokens, labels)
 
         # Embedding: scaler(tok) + scaler(pos), LayerNorm, dropout
         # (ref transformer.py:34-37).  ``pos_offset`` supports sequence-
         # sharded execution (each shard embeds its global positions).
-        off = batch.get("pos_offset", 0)
         pos = jax.lax.dynamic_slice_in_dim(params["embedding.pos.w"], off, S, axis=0)
         x = sc(embed(params["embedding.tok.w"], src_ids)) + sc(pos)[None, :, :]
         x = dropout(ln("embedding.norm", x), 0)
@@ -161,8 +171,9 @@ def _make_apply(num_tokens, E, H, F, num_layers, dropout_rate, bptt, mask_rate, 
             q, k, v = heads_split(q), heads_split(k), heads_split(v)
             if compute_dtype is not None:
                 q, k, v = (t.astype(compute_dtype) for t in (q, k, v))
-            if attn_impl is not None:
-                o = attn_impl(q, k, v, temp).astype(jnp.float32)
+            attn_fn = attn_override if attn_override is not None else attn_impl
+            if attn_fn is not None:
+                o = attn_fn(q, k, v, temp).astype(jnp.float32)
             else:
                 scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32) / temp
                 attn = jax.nn.softmax(scores, axis=-1)

@@ -37,6 +37,7 @@ except ImportError:  # pragma: no cover
 
 from ..data.datasets import DATASET_STATS
 from ..fed.core import combine_counted
+from .ring_attention import ring_attention
 from ..models.base import ModelDef
 from ..models.spec import count_masks as make_count_masks, mask_params, param_mask
 from ..ops.augment import augment_cifar, normalize_image
@@ -175,7 +176,17 @@ class RoundEngine:
         (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S))
         return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
 
-    def _local_train_lm(self, params, wr, rows, lm, key, lr, scaler_rate=None):
+    def _local_train_lm(self, params, wr, rows, lm, key, lr, scaler_rate=None,
+                        data_axis=None, n_data: int = 1):
+        """Local SGD on one client's token rows.
+
+        ``data_axis``/``n_data``: sequence parallelism -- each device on that
+        mesh axis holds ``bptt/n_data`` positions of every window, attention
+        runs as exact ring attention over the axis (ppermute neighbour
+        exchanges), and gradients are ``psum``-ed, so the result matches
+        single-device execution up to float association (token corruption is
+        drawn shard-invariantly; dropout shards are decorrelated by design).
+        """
         model, E, bptt = self.model, self.local_epochs, self.bptt
         R, T = rows.shape
         S = _ceil_div(T, bptt)
@@ -186,19 +197,45 @@ class RoundEngine:
         p = mask_params(params, model.specs, model.groups, wr)
         opt = self._opt_init(p)
 
+        seq_sharded = data_axis is not None and n_data > 1
+        if seq_sharded:
+            if bptt % n_data:
+                raise ValueError(f"data axis size ({n_data}) must divide bptt={bptt} "
+                                 f"for sequence-parallel LM rounds")
+            s_loc = bptt // n_data
+            attn = partial(ring_attention, axis_name=data_axis, axis_size=n_data)
+
         def step(carry, t):
             p, opt, acc = carry
             s = t % S
             lab = jax.lax.dynamic_slice(rows_p, (0, s * bptt), (R, bptt))
             w = jax.lax.dynamic_slice(wpos, (0, s * bptt), (R, bptt))
+            batch = {"label": lab}
+            extra = {}
+            if seq_sharded:
+                d = jax.lax.axis_index(data_axis)
+                off = d * s_loc
+                lab = jax.lax.dynamic_slice(lab, (0, off), (R, s_loc))
+                w = jax.lax.dynamic_slice(w, (0, off), (R, s_loc))
+                batch = {"label": lab, "pos_offset": off, "seq_full": bptt}
+                extra = {"attn_override": lambda q, k, v, temp: attn(q, k, v, temperature=temp)}
 
             def loss_fn(p):
-                out, _ = model.apply(p, {"label": lab}, train=True, width_rate=wr,
+                out, _ = model.apply(p, batch, train=True, width_rate=wr,
                                      scaler_rate=sr, label_mask=lm, sample_weight=w,
-                                     rng=jax.random.fold_in(key, 5000 + t))
-                return out["loss"]
+                                     rng=jax.random.fold_in(key, 5000 + t), **extra)
+                # weighted-SUM form so the cross-shard reduction recovers the
+                # exact full-window mean gradient
+                n_loc = jnp.sum(w)
+                return out["loss"] * n_loc, n_loc
 
-            loss, grads = jax.value_and_grad(loss_fn)(p)
+            (lsum, n_loc), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            if seq_sharded:
+                grads, lsum, n_glob = jax.lax.psum((grads, lsum, n_loc), data_axis)
+            else:
+                n_glob = n_loc
+            loss = lsum / jnp.maximum(n_glob, 1e-6)
+            grads = {k: g / jnp.maximum(n_glob, 1e-6) for k, g in grads.items()}
             grads = {k: g * param_mask(g.shape, model.specs[k], model.groups, wr)
                      for k, g in grads.items()}
             grads, _ = clip_by_global_norm(grads, 1.0)
@@ -220,13 +257,6 @@ class RoundEngine:
     def _build_train(self):
         model, cfg = self.model, self.cfg
         mesh = self.mesh
-        if self.is_lm and mesh.shape["data"] > 1:
-            import warnings
-
-            warnings.warn(
-                "transformer federated rounds replicate (not shard) over the "
-                "'data' mesh axis; use a clients-only mesh, or SeqParallelLM "
-                "for sequence parallelism", stacklevel=2)
         dynamic = cfg["model_split_mode"] == "dynamic"
         num_users = cfg["num_users"]
         n_dev = mesh.shape["clients"]
@@ -262,8 +292,11 @@ class RoundEngine:
                 all_rows, all_lm = data[0], data[1]
                 rows = all_rows[uidx]
                 lm = all_lm[uidx]
+                n_data = mesh.shape["data"]
                 trained, ms = jax.vmap(
-                    lambda w_, r_, l_, k_: self._local_train_lm(params, w_, r_, l_, k_, lr)
+                    lambda w_, r_, l_, k_: self._local_train_lm(
+                        params, w_, r_, l_, k_, lr,
+                        data_axis="data" if n_data > 1 else None, n_data=n_data)
                 )(wr, rows, lm, slot_keys)
             else:
                 all_x, all_y, all_m, all_lm = data[0], data[1], data[2], data[3]

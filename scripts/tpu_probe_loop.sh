@@ -1,0 +1,15 @@
+#!/bin/bash
+# Retry the TPU probe until it succeeds; append outcomes to the log.
+# Claim attempts can block ~30 min before failing, so no extra sleep needed
+# between failures beyond a short backoff.
+LOG=${1:-/tmp/tpu_probe.log}
+for i in $(seq 1 40); do
+  echo "=== probe attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
+  python -u "$(dirname "$0")/tpu_probe.py" >> "$LOG" 2>&1
+  if grep -q PROBE_OK "$LOG"; then
+    echo "=== PROBE SUCCEEDED attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
+    exit 0
+  fi
+  sleep 120
+done
+echo "=== probe gave up $(date -u +%H:%M:%S) ===" >> "$LOG"
