@@ -76,6 +76,10 @@ DEFAULT_CFG: Dict[str, Any] = {
     "save_format": "pdf",
     # TPU-native extras (no reference counterpart):
     "strategy": "masked",  # "masked" (one program, channel masks) | "sliced"
+    # "sharded": per-user train stacks live sharded over the clients axis and
+    # every client trains on the device owning its shard (device memory scales
+    # as U/n_devices); "replicated": all shards on every device.
+    "data_placement": "replicated",
     "param_dtype": "float32",
     "compute_dtype": "float32",  # set "bfloat16" to run matmuls/convs in bf16
     "mesh": {"clients": 0, "data": 1},  # 0 => use all available devices

@@ -17,3 +17,6 @@ from .pipeline import (  # noqa: F401
     label_split_masks,
 )
 from .vocab import Vocab  # noqa: F401
+from .download import check_integrity, download_url, extract_file  # noqa: F401
+from .hierarchy import ClassNode, make_flat_index, make_tree, tree_from_paths  # noqa: F401
+from .transforms import BoundingBoxCrop, Compose, CustomTransform  # noqa: F401

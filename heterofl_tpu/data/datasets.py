@@ -279,6 +279,29 @@ def _load_image_folder(root: str, split: str, data_name: str,
         if base is None:
             return None
         classes = _class_dirs(base)
+        if data_name == "ImageNet":
+            # ILSVRC synset hierarchy: when meta.mat is present, the label
+            # order follows the meta's leaf-synset order, not the sorted
+            # directory walk (ref src/datasets/imagenet.py:102-120 via
+            # make_tree/make_flat_index) -- sorted enumeration would label
+            # nested synsets differently than the reference.
+            meta = next((p for sub in ("", "raw", "data", os.path.join("raw", "data"))
+                         if os.path.isfile(p := os.path.join(root, sub, "meta.mat"))), None)
+            if meta is not None:
+                try:
+                    from .hierarchy import imagenet_meta_tree
+
+                    _, wnids, _ = imagenet_meta_tree(meta)
+                    by_name = {os.path.basename(d): d for d in classes}
+                    ordered = [by_name[w] for w in wnids if w in by_name]
+                    if ordered:
+                        classes = ordered
+                except ImportError:  # scipy absent: keep sorted order
+                    pass
+                except Exception as e:  # corrupt/v7.3 meta.mat: warn, keep sorted
+                    import warnings
+
+                    warnings.warn(f"ignoring unreadable {meta}: {e}")
     if not classes:
         return None
 

@@ -1,0 +1,81 @@
+"""Dataset acquisition: md5-checked downloads and archive extraction.
+
+Parity with the reference's download path (ref src/datasets/utils.py:16-110):
+``download_url`` fetches with an https->http retry and validates the md5;
+``extract_file`` dispatches on the archive suffix.  The loaders in
+:mod:`.datasets` are offline-first (they pick up standard on-disk formats);
+these helpers complete the story for boxes WITH egress.  stdlib-only.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import os
+import tarfile
+import zipfile
+from typing import Optional
+
+
+def calculate_md5(path: str, chunk_size: int = 1024 * 1024) -> str:
+    md5 = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(chunk_size), b""):
+            md5.update(chunk)
+    return md5.hexdigest()
+
+
+def check_integrity(path: str, md5: Optional[str] = None) -> bool:
+    """True iff ``path`` exists and (when given) matches ``md5``
+    (ref src/datasets/utils.py:82-87)."""
+    if not os.path.isfile(path):
+        return False
+    return md5 is None or calculate_md5(path) == md5
+
+
+def download_url(url: str, root: str, filename: Optional[str] = None,
+                 md5: Optional[str] = None) -> str:
+    """Fetch ``url`` into ``root/filename`` unless an md5-verified copy is
+    already there; https->http retry on failure; raise on a bad checksum
+    (ref src/datasets/utils.py:90-108).  Returns the local path."""
+    import urllib.request
+
+    filename = filename or os.path.basename(url)
+    path = os.path.join(root, filename)
+    os.makedirs(root, exist_ok=True)
+    if check_integrity(path, md5):
+        return path
+    try:
+        urllib.request.urlretrieve(url, path)
+    except OSError:
+        if not url.startswith("https:"):
+            raise
+        urllib.request.urlretrieve(url.replace("https:", "http:", 1), path)
+    if not check_integrity(path, md5):
+        raise RuntimeError(f"Not valid downloaded file: {path}")
+    return path
+
+
+def extract_file(src: str, dest: Optional[str] = None, delete: bool = False) -> None:
+    """Extract zip / tar / tar.gz / tgz / gz next to ``src`` (or into
+    ``dest``), optionally deleting the archive (ref
+    src/datasets/utils.py:111-129)."""
+    dest = os.path.dirname(src) if dest is None else dest
+    name = os.path.basename(src)
+    if name.endswith(".zip"):
+        with zipfile.ZipFile(src) as zf:
+            zf.extractall(dest)
+    elif name.endswith((".tar.gz", ".tgz")):
+        with tarfile.open(src, "r:gz") as tf:
+            tf.extractall(dest, filter="data")
+    elif name.endswith(".tar"):
+        with tarfile.open(src) as tf:
+            tf.extractall(dest, filter="data")
+    elif name.endswith(".gz"):
+        out = os.path.join(dest, os.path.basename(src)[: -len(".gz")])
+        with gzip.open(src, "rb") as zf, open(out, "wb") as f:
+            f.write(zf.read())
+    else:
+        raise ValueError(f"Not valid archive: {src}")
+    if delete:
+        os.remove(src)

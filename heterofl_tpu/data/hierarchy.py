@@ -1,0 +1,139 @@
+"""Class-hierarchy trees for nested-label datasets (ImageNet synsets).
+
+The reference builds anytree tries of class paths and labels images by each
+leaf's ``flat_index`` (ref src/datasets/utils.py:152-188, imagenet.py:102-120)
+-- so for nested synsets the label order follows the hierarchy's leaf order,
+NOT a flat sorted-directory enumeration.  This is the dependency-free
+equivalent: a trie of :class:`ClassNode` with the same index / flat-index
+assignment rules, used by the ImageNet loader in :mod:`.datasets`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class ClassNode:
+    """One node of a class trie: ``index`` is the path of child positions
+    from the root (anytree ``Node(..., index=...)`` parity)."""
+
+    __slots__ = ("name", "parent", "children", "index", "flat_index", "attrs")
+
+    def __init__(self, name: str, parent: Optional["ClassNode"] = None,
+                 index: Optional[List[int]] = None, **attrs: Any):
+        self.name = name
+        self.parent = parent
+        self.children: List[ClassNode] = []
+        self.index = list(index or [])
+        self.flat_index: Optional[int] = None
+        self.attrs = attrs
+        if parent is not None:
+            parent.children.append(self)
+
+    def child(self, name: str) -> Optional["ClassNode"]:
+        for c in self.children:
+            if c.name == name:
+                return c
+        return None
+
+    def find(self, name: str) -> Optional["ClassNode"]:
+        """First node named ``name`` in pre-order (anytree find_by_attr)."""
+        for node in preorder(self):
+            if node.name == name:
+                return node
+        return None
+
+    @property
+    def leaves(self) -> List["ClassNode"]:
+        return [n for n in preorder(self) if not n.children]
+
+    def __repr__(self):  # pragma: no cover
+        return f"ClassNode({self.name!r}, index={self.index}, flat={self.flat_index})"
+
+
+def preorder(root: ClassNode):
+    yield root
+    for c in root.children:
+        yield from preorder(c)
+
+
+def make_tree(root: ClassNode, names: Sequence[str],
+              attribute: Optional[Dict[str, Sequence[Any]]] = None) -> None:
+    """Insert the class path ``names`` (e.g. a synset chain) into the trie,
+    one node per level, threading per-level ``attribute`` values
+    (ref src/datasets/utils.py:152-168)."""
+    if not names:
+        return
+    attribute = attribute or {}
+    this_attr = {k: v[0] for k, v in attribute.items()}
+    next_attr = {k: v[1:] for k, v in attribute.items()}
+    node = root.child(names[0])
+    if node is None:
+        node = ClassNode(names[0], parent=root,
+                         index=root.index + [len(root.children)], **this_attr)
+    make_tree(node, names[1:], next_attr)
+
+
+def make_flat_index(root: ClassNode, given: Optional[Sequence[str]] = None) -> int:
+    """Assign ``flat_index`` to every leaf -- pre-order when ``given`` is
+    None, else each leaf's position in ``given`` -- and return the class
+    count (ref src/datasets/utils.py:175-188)."""
+    classes_size = 0
+    for i, leaf in enumerate(root.leaves):
+        if given is not None:
+            leaf.flat_index = given.index(leaf.name)
+            classes_size = max(classes_size, leaf.flat_index + 1)
+        else:
+            leaf.flat_index = i
+            classes_size = i + 1
+    return classes_size
+
+
+def tree_from_paths(paths: Sequence[Sequence[str]],
+                    given: Optional[Sequence[str]] = None) -> ClassNode:
+    """Build a rooted trie from class paths and flat-index it: the one-call
+    form used by loaders."""
+    root = ClassNode("U", index=[])
+    for p in paths:
+        make_tree(root, list(p))
+    make_flat_index(root, given)
+    return root
+
+
+def imagenet_meta_tree(meta_mat_path: str):
+    """Synset hierarchy from ILSVRC ``meta.mat`` (ref imagenet.py:102-120):
+    leaves are the 1000 wnids, each inserted with its root->leaf chain;
+    ``flat_index`` follows the meta's leaf order (``given=classes``).
+
+    Returns ``(root, classes, classes_size)`` where ``classes`` is the wnid
+    list defining the label order.  Requires scipy (gated by the caller).
+    """
+    import numpy as np
+    import scipy.io as sio
+
+    meta = sio.loadmat(meta_mat_path, squeeze_me=True)["synsets"]
+    rows = [tuple(r.item()) if hasattr(r, "item") else tuple(r) for r in meta]
+    # row: (id, wnid, classes, ..., num_children@4, children@5, ...)
+    by_id = {int(r[0]): r for r in rows}
+    parent_of: Dict[int, int] = {}
+    for r in rows:
+        kids = r[5]
+        if isinstance(kids, (int,)) and int(r[4]) > 0:
+            parent_of[int(kids)] = int(r[0])
+        elif hasattr(kids, "__len__"):
+            for k in np.atleast_1d(kids):
+                parent_of[int(k)] = int(r[0])
+    leaves = [r for r in rows if int(r[4]) == 0]
+    root = ClassNode("U", index=[])
+    classes = []
+    for leaf in leaves:
+        chain = []
+        nid = int(leaf[0])
+        while nid in by_id:
+            chain.append(str(by_id[nid][1]))
+            nid = parent_of.get(nid, -1)
+        chain = list(reversed(chain))
+        make_tree(root, chain)
+        classes.append(str(leaf[1]))
+    classes_size = make_flat_index(root, classes)
+    return root, classes, classes_size

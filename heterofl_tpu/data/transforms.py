@@ -1,0 +1,54 @@
+"""Dict-aware host-side transforms.
+
+Parity with the reference's transform plumbing (ref
+src/datasets/utils.py:191-211, src/datasets/transforms.py:1-17): samples are
+``{'img': ..., 'label': ...}`` dicts, a plain transform sees only the image,
+a :class:`CustomTransform` sees the whole dict (e.g. to read a bounding box).
+The TPU pipeline does its augmentation on device (ops/augment.py); these
+exist for host-side preprocessing parity and ad-hoc dataset preparation.
+numpy in, numpy out.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+import numpy as np
+
+
+class CustomTransform:
+    """Marker base: ``__call__(sample_dict) -> img`` instead of
+    ``__call__(img) -> img``."""
+
+    def __call__(self, sample: Dict[str, Any]):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Compose:
+    """Apply transforms in order; CustomTransforms get the whole sample
+    (ref src/datasets/utils.py:191-202)."""
+
+    def __init__(self, transforms: Sequence[Callable]):
+        self.transforms = list(transforms)
+
+    def __call__(self, sample: Dict[str, Any]) -> Dict[str, Any]:
+        for t in self.transforms:
+            if isinstance(t, CustomTransform):
+                sample["img"] = t(sample)
+            else:
+                sample["img"] = t(sample["img"])
+        return sample
+
+    def __repr__(self):
+        inner = "\n".join(f"    {t}" for t in self.transforms)
+        return f"{type(self).__name__}(\n{inner}\n)"
+
+
+class BoundingBoxCrop(CustomTransform):
+    """Crop ``img`` to the sample's ``bbox`` = (top, left, height, width)
+    (ref src/datasets/transforms.py:4-17)."""
+
+    def __call__(self, sample: Dict[str, Any]) -> np.ndarray:
+        img = np.asarray(sample["img"])
+        top, left, h, w = [int(v) for v in np.asarray(sample["bbox"]).tolist()]
+        return img[top: top + h, left: left + w]

@@ -167,6 +167,14 @@ class FedExperiment:
         return split_dataset(self.dataset, self.cfg["num_users"], self.cfg["data_split_mode"],
                              self.rng, classes_size=self.cfg["classes_size"])
 
+    def _place(self, data):
+        """Train stacks onto devices per ``cfg['data_placement']``."""
+        if self.cfg.get("data_placement") == "sharded" and self.sliced is None:
+            from ..parallel import shard_client_data
+
+            return shard_client_data(self.mesh, data)
+        return tuple(jnp.asarray(a) for a in data)
+
     def stage(self, data_split, label_split):
         cfg = self.cfg
         U = cfg["num_users"]
@@ -174,7 +182,7 @@ class FedExperiment:
             tr = self.dataset["train"]
             x, y, m = stack_client_shards(tr.data, tr.target, data_split["train"], list(range(U)))
             lm = label_split_masks(label_split, U, cfg["classes_size"])
-            self.train_data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+            self.train_data = self._place((x, y, m, lm))
             # sBN recalibration batches over the whole train set
             xb, wb = _batch_array(tr.data, cfg["batch_size"]["train"])
             self.sbn_batches = (xb, wb)
@@ -198,7 +206,7 @@ class FedExperiment:
             tr = self.dataset["train"]
             rows = stack_client_token_rows(tr.token, data_split["train"], list(range(U)))
             lm = label_split_masks(label_split, U, cfg["num_tokens"])
-            self.train_data = (jnp.asarray(rows), jnp.asarray(lm))
+            self.train_data = self._place((rows, lm))
             te = self.dataset["test"]
             xs, ws = stack_windows(bptt_windows(te.token, cfg["bptt"]), cfg["bptt"])
             self.global_eval = (xs, ws)
@@ -281,6 +289,20 @@ class FedExperiment:
     def run(self, pivot_metric: str, pivot_mode: str = "max") -> Dict[str, Any]:
         cfg = self.cfg
         blob = resume(cfg["output_dir"], self.tag, cfg["resume_mode"])
+        if jax.process_count() > 1:
+            # checkpoints are written by process 0 only, so resume requires a
+            # SHARED output_dir; detect per-host local dirs (hosts 1..k see no
+            # blob) before they diverge into different round counts
+            from jax.experimental import multihost_utils
+
+            epoch0 = int(multihost_utils.broadcast_one_to_all(
+                jnp.int32(blob.get("epoch", 0) if blob else 0)))
+            mine = int(blob.get("epoch", 0) if blob else 0)
+            if mine != epoch0:
+                raise RuntimeError(
+                    f"resume state differs across hosts (process 0 at epoch "
+                    f"{epoch0}, this host at {mine}): output_dir must be a "
+                    f"shared filesystem for multi-host resume")
         if blob and "data_split" in blob and blob["data_split"] is not None:
             data_split, label_split = blob["data_split"], blob["label_split"]
         else:
@@ -296,6 +318,8 @@ class FedExperiment:
                 last_epoch = blob["epoch"]
                 pivot = blob.get("pivot", pivot)
                 logger.history = blob.get("logger_history", logger.history)
+                if blob.get("scheduler_state") and hasattr(self.scheduler, "load_state_dict"):
+                    self.scheduler.load_state_dict(blob["scheduler_state"])
         n_rounds = cfg["num_epochs"]["global"]
         eval_interval = max(1, int(cfg.get("eval_interval", 1) or 1))
         for epoch in range(last_epoch, n_rounds + 1):
@@ -326,10 +350,16 @@ class FedExperiment:
                 "bn_state": getattr(self, "bn_state", {}),
                 "pivot": pivot,
                 "logger_history": dict(logger.history),
+                "scheduler_state": self.scheduler.state_dict()
+                if hasattr(self.scheduler, "state_dict") else None,
             }
-            save_checkpoint(checkpoint_path(cfg["output_dir"], self.tag), blob_out)
-            if is_best:
-                copy_best(cfg["output_dir"], self.tag)
+            # multi-host: params/metrics are replicated, so only process 0
+            # writes (every host writing the same file corrupts shared
+            # filesystems; harmless no-op on a single host)
+            if jax.process_index() == 0:
+                save_checkpoint(checkpoint_path(cfg["output_dir"], self.tag), blob_out)
+                if is_best:
+                    copy_best(cfg["output_dir"], self.tag)
             logger.reset()
         return {"params": params, "bn_state": getattr(self, "bn_state", {}),
                 "logger": logger, "data_split": data_split, "label_split": label_split}

@@ -64,8 +64,8 @@ class SlicedFederation:
                     ):
         """One round. ``data`` is the same stacked tuple the masked engine
         takes (vision: ``x[U,N,...], y, m, lm``; LM: ``rows[U,R,T], lm``).
-        Client slot ``i`` uses PRNG key ``fold_in(key, i + 13)``, matching the
-        masked engine on a single-device mesh."""
+        Client ``u`` uses PRNG key ``fold_in(key, 13 + u)`` (its global user
+        id), matching the masked engine on any mesh/placement."""
         gp_np = {k: np.asarray(v) for k, v in global_params.items()}
         shapes = {k: v.shape for k, v in gp_np.items()}
         summed = {k: np.zeros(s, np.float32) for k, s in shapes.items()}
@@ -88,8 +88,8 @@ class SlicedFederation:
             sliced = extract_sliced(gp_np, gm.specs, gm.groups, wr)
             params_stack = {k: jnp.asarray(np.broadcast_to(
                 v, (len(slots),) + v.shape)) for k, v in sliced.items()}
-            keys = jnp.stack([jax.random.fold_in(key, s + 13) for s in slots])
             u = user_idx[slots]
+            keys = jnp.stack([jax.random.fold_in(key, 13 + int(ui)) for ui in u])
             client_data = tuple(jnp.asarray(np.asarray(a)[u]) for a in data)
             trained, ms = self._level_fn(rate)(params_stack, *client_data, keys,
                                                jnp.asarray(lr, jnp.float32))

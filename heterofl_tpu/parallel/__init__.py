@@ -1,2 +1,2 @@
 from .mesh import make_mesh  # noqa: F401
-from .round_engine import RoundEngine  # noqa: F401
+from .round_engine import RoundEngine, shard_client_data  # noqa: F401

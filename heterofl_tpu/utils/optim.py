@@ -162,3 +162,14 @@ class PlateauScheduler:
             if self.bad > self.patience:
                 self.lr = max(self.lr * self.factor, self.min_lr)
                 self.bad = 0
+
+    def state_dict(self) -> Dict[str, float]:
+        """Mutable state for checkpoints (the reference pickles the whole
+        torch scheduler, src/utils.py:302-312); a resumed run keeps its
+        plateau counters instead of restarting them."""
+        return {"lr": self.lr, "best": self.best, "bad": self.bad}
+
+    def load_state_dict(self, state: Dict[str, float]) -> None:
+        self.lr = float(state["lr"])
+        self.best = float(state["best"])
+        self.bad = int(state["bad"])

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""TPU measurement battery for the round program (VERDICT r1 item 2).
+
+Answers, with wall-clock numbers on real TPU hardware:
+  1. masked vs sliced at the headline a1-b1-c1-d1-e1 mix -- the masked
+     strategy runs every client at full width (~3.9x the FLOPs of true
+     sliced sub-models); is it still faster than 5 per-level programs?
+  2. bf16 vs f32 round time.
+  3. width -> round-time curve (is the chip FLOPs-bound or latency-bound
+     at these shapes?).
+  4. vmapped-client-count -> round-time curve (occupancy headroom; informs
+     slot padding waste under sharded placement).
+
+Run on the TPU box: `python -u scripts/tpu_measure.py [--quick]`.
+Prints one JSON line per measurement (incremental -- a wedge mid-battery
+still leaves everything before it on stdout), plus a final summary line.
+Never kill it mid-run: the tunnel is single-client and stale grants wedge it.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="1 timed round each")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--users", type=int, default=100)
+    ap.add_argument("--n_train", type=int, default=50000)
+    args = ap.parse_args()
+    timed = 1 if args.quick else args.rounds
+
+    import jax
+    import jax.numpy as jnp
+
+    from heterofl_tpu import config as C
+    from heterofl_tpu.data import (fetch_dataset, label_split_masks, split_dataset,
+                                   stack_client_shards)
+    from heterofl_tpu.models import make_model
+    from heterofl_tpu.parallel import RoundEngine, make_mesh
+
+    platform = jax.devices()[0].platform
+    print(json.dumps({"measure": "platform", "platform": platform,
+                      "device_kind": jax.devices()[0].device_kind,
+                      "n_devices": len(jax.devices())}), flush=True)
+
+    def build_cfg(control, dtype="bfloat16"):
+        cfg = C.default_cfg()
+        cfg["control"] = C.parse_control_name(control)
+        cfg["data_name"] = "CIFAR10"
+        cfg["model_name"] = "resnet18"
+        cfg["synthetic"] = True
+        cfg["compute_dtype"] = dtype
+        return C.process_control(cfg)
+
+    users = args.users
+    base = build_cfg(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    ds = fetch_dataset("CIFAR10", synthetic=True, seed=0,
+                       synthetic_sizes={"train": args.n_train, "test": 1000})
+    rng = np.random.default_rng(0)
+    split, lsplit = split_dataset(ds, users, "iid", rng)
+    x, y, m = stack_client_shards(ds["train"].data, ds["train"].target,
+                                  split["train"], list(range(users)))
+    lm = label_split_masks(lsplit, users, 10)
+    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+    n_active = int(np.ceil(base["frac"] * users))
+
+    def time_masked(name, cfg, active=None, extra=None):
+        cfg = dict(cfg)
+        cfg["classes_size"] = 10
+        model = make_model(cfg)
+        params = model.init(jax.random.key(0))
+        engine = RoundEngine(model, cfg, make_mesh(len(jax.devices()), 1))
+        a = active if active is not None else n_active
+        srng = np.random.default_rng(1)
+
+        def once(params, r):
+            uidx = srng.permutation(users)[:a].astype(np.int32)
+            return engine.train_round(params, jax.random.key(r), 0.1, uidx, data)
+
+        t0 = time.time()
+        params, _ = once(params, 0)
+        jax.block_until_ready(params)
+        compile_s = time.time() - t0
+        t0 = time.time()
+        for r in range(1, timed + 1):
+            params, ms = once(params, r)
+        jax.block_until_ready(params)
+        dt = (time.time() - t0) / timed
+        rec = {"measure": name, "round_sec": round(dt, 4),
+               "compile_sec": round(compile_s, 1), "active": a,
+               **(extra or {})}
+        print(json.dumps(rec), flush=True)
+        return dt
+
+    results = {}
+
+    # 1a. masked, headline mix, bf16 (the bench configuration)
+    results["masked_bf16"] = time_masked("masked_a1-e1_bf16", base)
+    # 2. masked, f32
+    results["masked_f32"] = time_masked(
+        "masked_a1-e1_f32", build_cfg(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_bn_1_1",
+                                      "float32"))
+
+    # 1b. sliced strategy, same mix, bf16: 5 per-level programs + host scatter
+    from heterofl_tpu.fed.sliced import SlicedFederation
+    cfg_s = dict(base)
+    cfg_s["classes_size"] = 10
+    model = make_model(cfg_s)
+    params = {k: np.asarray(v) for k, v in model.init(jax.random.key(0)).items()}
+    sliced = SlicedFederation(cfg_s)
+    fix_rates = np.asarray(cfg_s["model_rate"], np.float32)
+    srng = np.random.default_rng(1)
+
+    def sliced_once(params, r):
+        uidx = srng.permutation(users)[:n_active].astype(np.int32)
+        return sliced.train_round(params, uidx, fix_rates[uidx], data, 0.1,
+                                  jax.random.key(r))
+
+    t0 = time.time()
+    params, _ = sliced_once(params, 0)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    for r in range(1, timed + 1):
+        params, _ = sliced_once(params, r)
+    dt = (time.time() - t0) / timed
+    print(json.dumps({"measure": "sliced_a1-e1_bf16", "round_sec": round(dt, 4),
+                      "compile_sec": round(compile_s, 1), "active": n_active}),
+          flush=True)
+    results["sliced_bf16"] = dt
+
+    # 3. width -> time (homogeneous masked rounds; all clients one level)
+    for mode, label in (("a1", "w1.0"), ("c1", "w0.25"), ("e1", "w0.0625")):
+        results[f"width_{label}"] = time_masked(
+            f"masked_homog_{label}_bf16",
+            build_cfg(f"1_{users}_0.1_iid_fix_{mode}_bn_1_1"))
+
+    # 4. active-client scaling at the headline mix
+    for a in (1, 2, 5, 10, 20):
+        results[f"clients_{a}"] = time_masked(f"masked_a1-e1_bf16_active{a}",
+                                              base, active=a, extra={"sweep": "clients"})
+
+    summary = {
+        "measure": "summary",
+        "masked_vs_sliced_speedup": round(results["sliced_bf16"] / results["masked_bf16"], 2),
+        "bf16_vs_f32_speedup": round(results["masked_f32"] / results["masked_bf16"], 2),
+        "width_ratio_w1_over_w116": round(results["width_w1.0"] / results["width_w0.0625"], 2),
+        "rounds_per_sec_masked_bf16": round(1.0 / results["masked_bf16"], 3),
+    }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -5,11 +5,17 @@
 LOG=${1:-/tmp/tpu_probe.log}
 for i in $(seq 1 40); do
   echo "=== probe attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
-  python -u "$(dirname "$0")/tpu_probe.py" >> "$LOG" 2>&1
-  if grep -q PROBE_OK "$LOG"; then
+  # per-attempt capture: grepping the cumulative log would match a stale
+  # PROBE_OK from an earlier run
+  ATTEMPT=$(mktemp)
+  python -u "$(dirname "$0")/tpu_probe.py" > "$ATTEMPT" 2>&1
+  cat "$ATTEMPT" >> "$LOG"
+  if grep -q PROBE_OK "$ATTEMPT"; then
+    rm -f "$ATTEMPT"
     echo "=== PROBE SUCCEEDED attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
     exit 0
   fi
+  rm -f "$ATTEMPT"
   sleep 120
 done
 echo "=== probe gave up $(date -u +%H:%M:%S) ===" >> "$LOG"

@@ -44,6 +44,47 @@ def test_profile_and_summary(tmp_path):
     assert "| a | 1 |" in out["report"]
     assert os.path.exists(tmp_path / "summary.md")
     assert os.path.exists(tmp_path / "result" / "MNIST_conv_a.pkl")
+    assert "Per-module profile" in (tmp_path / "summary.md").read_text()
+
+
+def test_module_table_conv_exact():
+    """Per-leaf-module profile (ref summary.py:68-152): conv MACs follow the
+    reference's hand formulas; params across rows account for every model
+    parameter."""
+    import jax
+
+    from heterofl_tpu.analysis.summary import module_table
+    from heterofl_tpu.models import make_model
+
+    cfg = small_cfg("conv")  # hidden [8,16], MNIST 28x28x1
+    bs = 2
+    rows = module_table(cfg, 1.0, batch_size=bs)
+    by_name = {r[0]: r for r in rows}
+    # block0.conv: 3*3*1*8 MACs per output position + bias, 28x28 out
+    assert by_name["block0.conv"][4] == 3 * 3 * 1 * 8 * bs * 28 * 28 + 8 * bs * 28 * 28
+    # block1 after one pool: 14x14
+    assert by_name["block1.conv"][4] == 3 * 3 * 8 * 16 * bs * 14 * 14 + 16 * bs * 14 * 14
+    assert by_name["linear"][4] == bs * 16 * 10
+    params = make_model(cfg).init(jax.random.key(0))
+    total = sum(int(v.size) for v in params.values())
+    assert sum(r[3] for r in rows) == total
+
+
+def test_module_table_params_complete_all_families():
+    """Row param counts sum to the model's param count for resnet and
+    transformer too (catches drift between the table and the real models)."""
+    import jax
+
+    from heterofl_tpu.analysis.summary import module_table
+    from heterofl_tpu.models import make_model
+
+    for name in ("resnet18", "resnet50", "transformer"):
+        cfg = small_cfg(name, data_name="WikiText2" if name == "transformer" else "MNIST")
+        rows = module_table(cfg, 1.0, batch_size=2)
+        params = make_model(cfg).init(jax.random.key(0))
+        total = sum(int(v.size) for v in params.values())
+        assert sum(r[3] for r in rows) == total, (name, sum(r[3] for r in rows), total)
+        assert all(r[4] >= 0 for r in rows)
 
 
 def test_process_aggregation(tmp_path):
