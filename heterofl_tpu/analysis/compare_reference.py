@@ -41,13 +41,15 @@ def _import_reference():
 
 
 def _setup(seed: int, users: int, hidden, n_train: int, n_test: int,
-           model_name: str = "conv"):
+           model_name: str = "conv", data_name: str = "MNIST", frac: float = 0.5,
+           split_mode: str = "iid", local_epochs: int = 1):
     from ..config import default_cfg, parse_control_name, process_control
     from ..data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
 
     cfg = default_cfg()
-    cfg["control"] = parse_control_name(f"1_{users}_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
-    cfg["data_name"] = "MNIST"
+    cfg["control"] = parse_control_name(
+        f"1_{users}_{frac}_{split_mode}_fix_a1-b1-c1-d1-e1_bn_1_1")
+    cfg["data_name"] = data_name
     cfg["model_name"] = model_name
     cfg = process_control(cfg)
     cfg["conv"] = {"hidden_size": list(hidden)}
@@ -55,13 +57,19 @@ def _setup(seed: int, users: int, hidden, n_train: int, n_test: int,
     while len(widths) < 4:  # extend monotonically by doubling (resnet stages)
         widths.append(widths[-1] * 2)
     cfg["resnet"] = {"hidden_size": widths[:4]}
-    cfg["num_epochs"] = {"global": 1, "local": 1}
+    cfg["num_epochs"] = {"global": 1, "local": local_epochs}
     cfg["batch_size"] = {"train": 10, "test": 50}
-    ds = fetch_dataset("MNIST", synthetic=True, seed=seed,
+    # identical raw pixels for both frameworks; augmentation is OFF on both
+    # sides (different RNG streams would otherwise blur the comparison)
+    from ..data.datasets import DATASET_STATS
+
+    cfg["norm_stats"] = DATASET_STATS[data_name]
+    cfg["data_name"] = "SYNTH-" + data_name  # disables the CIFAR augment path
+    ds = fetch_dataset(data_name, synthetic=True, seed=seed,
                        synthetic_sizes={"train": n_train, "test": n_test})
     cfg["classes_size"] = 10
     rng = np.random.default_rng(seed)
-    split, lsplit = split_dataset(ds, users, "iid", rng, classes_size=10)
+    split, lsplit = split_dataset(ds, users, split_mode, rng, classes_size=10)
     return cfg, ds, split, lsplit
 
 
@@ -71,19 +79,21 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
 
     ref_cfg, ref_models, Federation = _import_reference()
     model_name = cfg["model_name"]
+    h, w, c = cfg["data_shape"]
     ref_cfg.update({
         "norm": "bn", "scale": True, "mask": True, "global_model_rate": 1.0,
         "classes_size": 10, "conv": dict(cfg["conv"]), "resnet": dict(cfg["resnet"]),
-        "data_shape": [1, 28, 28],
+        "data_shape": [c, h, w],
         "device": "cpu", "model_name": model_name, "model_split_mode": "fix",
         "model_rate": list(cfg["model_rate"]),
     })
     factory = getattr(ref_models, model_name)
-    mean, std = 0.1307, 0.3081
+    mean = np.asarray(cfg["norm_stats"][0], np.float32)
+    std = np.asarray(cfg["norm_stats"][1], np.float32)
 
     def to_img(idx_list):
         x = ds["train"].data[idx_list].astype(np.float32) / 255.0
-        x = (x - mean) / std
+        x = (x - mean) / std  # broadcasts over the trailing channel axis
         return torch.tensor(x.transpose(0, 3, 1, 2).copy())
 
     torch.manual_seed(seed)
@@ -105,18 +115,19 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
             tm.train(True)
             opt = torch.optim.SGD(tm.parameters(), lr=lr, momentum=0.9, weight_decay=5e-4)
             idx = np.array(split["train"][u])
-            perm = shuffle_rng.permutation(len(idx))
             B = cfg["batch_size"]["train"]
-            for s in range(0, len(idx), B):
-                batch_idx = idx[perm[s: s + B]]
-                inp = {"img": to_img(batch_idx),
-                       "label": torch.tensor(ds["train"].target[batch_idx]),
-                       "label_split": torch.tensor(lsplit[u])}
-                opt.zero_grad()
-                out = tm(inp)
-                out["loss"].backward()
-                torch.nn.utils.clip_grad_norm_(tm.parameters(), 1)
-                opt.step()
+            for _ in range(cfg["num_epochs"]["local"]):
+                perm = shuffle_rng.permutation(len(idx))
+                for s in range(0, len(idx), B):
+                    batch_idx = idx[perm[s: s + B]]
+                    inp = {"img": to_img(batch_idx),
+                           "label": torch.tensor(ds["train"].target[batch_idx]),
+                           "label_split": torch.tensor(lsplit[u])}
+                    opt.zero_grad()
+                    out = tm(inp)
+                    out["loss"].backward()
+                    torch.nn.utils.clip_grad_norm_(tm.parameters(), 1)
+                    opt.step()
             local_params[m] = tm.state_dict()
         fed.combine(local_params, param_idx, user_idx)
         # sBN recalibration with a fresh track=True model over the train set
@@ -130,7 +141,7 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
             test_model.train(False)
             correct = 0
             xt = ds["test"].data.astype(np.float32) / 255.0
-            xt = (xt - mean) / std
+            xt = (xt - mean) / std  # broadcasts over the trailing channel axis
             out = test_model({"img": torch.tensor(xt.transpose(0, 3, 1, 2).copy()),
                               "label": torch.tensor(ds["test"].target)})
             correct = (out["score"].argmax(1).numpy() == ds["test"].target).mean()
@@ -185,14 +196,26 @@ def main(argv=None):
     parser.add_argument("--seed", default=0, type=int)
     parser.add_argument("--out", default=None, type=str)
     parser.add_argument("--model", default="conv", type=str, choices=["conv", "resnet18"])
+    parser.add_argument("--data", default="MNIST", type=str, choices=["MNIST", "CIFAR10"])
+    parser.add_argument("--frac", default=0.5, type=float)
+    parser.add_argument("--split", default="iid", type=str,
+                        help="iid or non-iid-N (ref src/data.py:79-110)")
+    parser.add_argument("--local_epochs", default=1, type=int)
+    parser.add_argument("--skip", default="", type=str,
+                        help="'reference' or 'mine': emit only the other side")
     args = parser.parse_args(argv)
     hidden = [int(h) for h in args.hidden.split(",")]
     cfg, ds, split, lsplit = _setup(args.seed, args.users, hidden, args.n_train, args.n_test,
-                                    model_name=args.model)
-    ref = run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
-    mine = run_mine(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
-    report = {"reference_acc": ref, "mine_acc": mine,
-              "final_gap_pp": round(mine[-1] - ref[-1], 2)}
+                                    model_name=args.model, data_name=args.data,
+                                    frac=args.frac, split_mode=args.split,
+                                    local_epochs=args.local_epochs)
+    ref = [] if args.skip == "reference" else \
+        run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+    mine = [] if args.skip == "mine" else \
+        run_mine(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+    report = {"reference_acc": ref, "mine_acc": mine}
+    if ref and mine:
+        report["final_gap_pp"] = round(mine[-1] - ref[-1], 2)
     print(json.dumps(report))
     if args.out:
         with open(args.out, "w") as f:

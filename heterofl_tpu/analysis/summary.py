@@ -60,18 +60,23 @@ def profile_model(cfg: Dict[str, Any], model_rate: float, batch_size: Optional[i
         flops = float(ca.get("flops", float("nan")))
     except Exception as e:  # pragma: no cover - cost analysis availability varies
         flops_error = f"{type(e).__name__}: {e}"
+    flops_source = "xla_cost_analysis"
     if not np.isfinite(flops):
         # never degrade silently (VERDICT r1 weak 7): fall back to the
-        # analytic per-module count and SAY so
+        # analytic per-module count (x2: MACs -> flops, matching the HLO
+        # convention so the field is unit-consistent across environments),
+        # SAY so, and record the source in the result
         import sys
 
-        flops = float(sum(r[4] for r in module_table(cfg, model_rate, bs)))
+        flops = 2.0 * float(sum(r[4] for r in module_table(cfg, model_rate, bs)))
+        flops_source = "analytic_2x_macs"
         print(f"summary: XLA cost_analysis unavailable"
               f"{' (' + flops_error + ')' if flops_error else ''}; "
-              f"using analytic per-module FLOPs", file=sys.stderr)
+              f"using analytic per-module FLOPs (2x MACs)", file=sys.stderr)
     per_param = [(k, tuple(v.shape), int(np.prod(v.shape))) for k, v in params.items()]
     return {"num_params": num_params, "num_flops": flops, "space_mb": space_mb,
             "batch_size": bs, "per_param": per_param, "model_rate": model_rate,
+            "flops_source": flops_source,
             **({"flops_error": flops_error} if flops_error else {})}
 
 
@@ -213,7 +218,8 @@ def make_summary(cfg: Dict[str, Any], rates: Optional[List[float]] = None,
                                 f"{cfg['data_name']}_{cfg['model_name']}_{mode}.pkl")
             os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(path, "wb") as f:
-                pickle.dump({k: prof[k] for k in ("num_params", "num_flops", "space_mb")}, f)
+                pickle.dump({k: prof[k] for k in ("num_params", "num_flops", "space_mb",
+                                                  "flops_source")}, f)
     lines = ["| mode | rate | params | fwd FLOPs/batch | space (MB) |",
              "|------|------|--------|-----------------|------------|"]
     base = rows[0]

@@ -80,6 +80,8 @@ DEFAULT_CFG: Dict[str, Any] = {
     # every client trains on the device owning its shard (device memory scales
     # as U/n_devices); "replicated": all shards on every device.
     "data_placement": "replicated",
+    # fuse the train-time masked BN into a Pallas TPU kernel (ops/pallas_norm.py)
+    "pallas_norm": False,
     "param_dtype": "float32",
     "compute_dtype": "float32",  # set "bfloat16" to run matmuls/convs in bf16
     "mesh": {"clients": 0, "data": 1},  # 0 => use all available devices

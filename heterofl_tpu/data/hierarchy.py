@@ -117,11 +117,10 @@ def imagenet_meta_tree(meta_mat_path: str):
     by_id = {int(r[0]): r for r in rows}
     parent_of: Dict[int, int] = {}
     for r in rows:
-        kids = r[5]
-        if isinstance(kids, (int,)) and int(r[4]) > 0:
-            parent_of[int(kids)] = int(r[0])
-        elif hasattr(kids, "__len__"):
-            for k in np.atleast_1d(kids):
+        # squeeze_me squeezes a single-child 'children' field to a numpy
+        # scalar -- atleast_1d handles scalar, 0-d and array uniformly
+        if int(r[4]) > 0:
+            for k in np.atleast_1d(r[5]):
                 parent_of[int(k)] = int(r[0])
     leaves = [r for r in rows if int(r[4]) == 0]
     root = ClassNode("U", index=[])

@@ -50,16 +50,17 @@ def make_model(cfg: Dict[str, Any], model_rate: Optional[float] = None) -> Model
         model_rate = cfg["global_model_rate"]
     scaler_rate = model_rate / cfg["global_model_rate"]
     compute_dtype = parse_compute_dtype(cfg.get("compute_dtype"))
+    pallas_norm = bool(cfg.get("pallas_norm", False))
     if name == "conv":
         model = make_conv(cfg["data_shape"], scaled_hidden(cfg["conv"]["hidden_size"], model_rate),
                           cfg["classes_size"], norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"],
-                          compute_dtype=compute_dtype)
+                          compute_dtype=compute_dtype, pallas_norm=pallas_norm)
     elif name in RESNET_BLOCKS:
         num_blocks, bottleneck = RESNET_BLOCKS[name]
         model = make_resnet(cfg["data_shape"], scaled_hidden(cfg["resnet"]["hidden_size"], model_rate),
                             num_blocks, cfg["classes_size"], bottleneck=bottleneck,
                             norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"],
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype, pallas_norm=pallas_norm)
     elif name == "transformer":
         t = cfg["transformer"]
         model = make_transformer(

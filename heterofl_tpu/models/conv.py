@@ -22,7 +22,8 @@ from .spec import Group, ParamSpec
 
 
 def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
-              scale: bool = True, mask: bool = True, compute_dtype=None) -> ModelDef:
+              scale: bool = True, mask: bool = True, compute_dtype=None,
+              pallas_norm: bool = False) -> ModelDef:
     """Build the CNN at the given (global) widths.
 
     ``hidden_size`` are the *constructed* widths: the global model passes
@@ -77,7 +78,7 @@ def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
                 norm, x, params.get(f"{site}.g"), params.get(f"{site}.b"),
                 mask=g.mask(width_rate), k=g.active_count(width_rate),
                 bn_mode=bn_mode, bn_running=None if bn_state is None else bn_state.get(site),
-                sample_weight=sample_weight, bn_axis=bn_axis)
+                sample_weight=sample_weight, bn_axis=bn_axis, use_pallas=pallas_norm)
             if st is not None:
                 collected[site] = st
             x = jax.nn.relu(x)

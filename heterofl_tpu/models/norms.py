@@ -33,7 +33,7 @@ def apply_norm(norm_type: str, x: jnp.ndarray, g: Optional[jnp.ndarray],
                bn_mode: str = "batch",
                bn_running: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
                sample_weight: Optional[jnp.ndarray] = None,
-               bn_axis=None):
+               bn_axis=None, use_pallas: bool = False):
     """Apply one norm site. Returns ``(y, bn_stats_or_None)``.
 
     ``mask``/``k``: channel activity mask and active count for the client's
@@ -42,6 +42,11 @@ def apply_norm(norm_type: str, x: jnp.ndarray, g: Optional[jnp.ndarray],
     if norm_type == "none":
         return x, None
     if norm_type == "bn":
+        if (use_pallas and bn_mode == "batch" and bn_running is None
+                and bn_axis is None):
+            from ..ops.pallas_norm import batch_norm_pallas
+
+            return batch_norm_pallas(x, g, b, sample_weight=sample_weight), None
         return batch_norm(x, g, b, mode=bn_mode, running=bn_running,
                           sample_weight=sample_weight, axis_name=bn_axis)
     if norm_type == "in":

@@ -29,7 +29,8 @@ from .spec import Group, ParamSpec
 
 def make_resnet(data_shape, hidden_size, num_blocks: List[int], classes_size: int, *,
                 bottleneck: bool = False, norm: str = "bn", scale: bool = True,
-                mask: bool = True, compute_dtype=None) -> ModelDef:
+                mask: bool = True, compute_dtype=None,
+                pallas_norm: bool = False) -> ModelDef:
     in_ch = data_shape[-1]
     expansion = 4 if bottleneck else 1
     n_stages = len(hidden_size)
@@ -127,7 +128,7 @@ def make_resnet(data_shape, hidden_size, num_blocks: List[int], classes_size: in
                 norm, x, params.get(f"{site}.g"), params.get(f"{site}.b"),
                 mask=g.mask(width_rate), k=g.active_count(width_rate),
                 bn_mode=bn_mode, bn_running=None if bn_state is None else bn_state.get(site),
-                sample_weight=sample_weight, bn_axis=bn_axis)
+                sample_weight=sample_weight, bn_axis=bn_axis, use_pallas=pallas_norm)
             if st is not None:
                 collected[site] = st
             return y

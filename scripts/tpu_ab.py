@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""A/B timings for the masked round program: where do the 20ms/step go?
+
+Each variant disables ONE ingredient of the round step (augmentation, global
+-norm clip, per-step gradient masking is load-bearing and not toggled, BN vs
+no norm) and re-times the bench round.  Monkeypatched, not config-driven:
+these are measurements, not features.  Run after/instead of tpu_measure.py
+inside one TPU claim; prints one JSON line per variant.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from heterofl_tpu import config as C
+    from heterofl_tpu.data import (fetch_dataset, label_split_masks, split_dataset,
+                                   stack_client_shards)
+    from heterofl_tpu.models import make_model
+    from heterofl_tpu.parallel import RoundEngine, make_mesh
+    import heterofl_tpu.parallel.round_engine as re_mod
+
+    users, n_train, timed = 100, 50000, 3
+    print(json.dumps({"measure": "platform",
+                      "platform": jax.devices()[0].platform,
+                      "device_kind": jax.devices()[0].device_kind}), flush=True)
+
+    ds = fetch_dataset("CIFAR10", synthetic=True, seed=0,
+                       synthetic_sizes={"train": n_train, "test": 1000})
+    rng = np.random.default_rng(0)
+    split, lsplit = split_dataset(ds, users, "iid", rng)
+    x, y, m = stack_client_shards(ds["train"].data, ds["train"].target,
+                                  split["train"], list(range(users)))
+    lm = label_split_masks(lsplit, users, 10)
+    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+
+    def run(name, norm="bn", dtype="bfloat16", augment=True, clip=True,
+            pallas_norm=False):
+        cfg = C.default_cfg()
+        cfg["control"] = C.parse_control_name(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_{norm}_1_1")
+        cfg["data_name"] = "CIFAR10"
+        cfg["model_name"] = "resnet18"
+        cfg["synthetic"] = True
+        cfg["compute_dtype"] = dtype
+        cfg = C.process_control(cfg)
+        cfg["classes_size"] = 10
+        cfg["pallas_norm"] = pallas_norm
+
+        orig_clip = re_mod.clip_by_global_norm
+        orig_aug = re_mod.augment_cifar
+        if not clip:
+            re_mod.clip_by_global_norm = lambda g, c: (g, jnp.zeros(()))
+        if not augment:
+            re_mod.augment_cifar = lambda k, xx: xx
+        try:
+            model = make_model(cfg)
+            params = model.init(jax.random.key(0))
+            eng = RoundEngine(model, cfg, make_mesh(len(jax.devices()), 1))
+            srng = np.random.default_rng(1)
+
+            def once(p, r):
+                uidx = srng.permutation(users)[:10].astype(np.int32)
+                return eng.train_round(p, jax.random.key(r), 0.1, uidx, data)
+
+            t0 = time.time()
+            params, _ = once(params, 0)
+            jax.block_until_ready(params)
+            compile_s = time.time() - t0
+            t0 = time.time()
+            for r in range(1, timed + 1):
+                params, ms = once(params, r)
+            jax.block_until_ready(params)
+            dt = (time.time() - t0) / timed
+        finally:
+            re_mod.clip_by_global_norm = orig_clip
+            re_mod.augment_cifar = orig_aug
+        print(json.dumps({"measure": name, "round_sec": round(dt, 4),
+                          "ms_per_step": round(dt / 250 * 1000, 2),
+                          "compile_sec": round(compile_s, 1)}), flush=True)
+        return dt
+
+    base = run("base_bf16_bn_aug_clip")
+    run("no_augment", augment=False)
+    run("no_clip", clip=False)
+    run("no_augment_no_clip", augment=False, clip=False)
+    run("norm_none", norm="none")
+    run("f32_all_on", dtype="float32")
+    run("pallas_norm", pallas_norm=True)
+    run("pallas_norm_f32", pallas_norm=True, dtype="float32")
+
+
+if __name__ == "__main__":
+    main()
