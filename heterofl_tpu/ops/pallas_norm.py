@@ -38,7 +38,8 @@ def _masks(i, w_ref, m_total, block_m):
     multiplied out."""
     row = jax.lax.broadcasted_iota(jnp.int32, (block_m, 1), 0) + i * block_m
     rowmask = (row < m_total).astype(jnp.float32)
-    return rowmask, rowmask * w_ref[:]
+    # where, not multiply: the padding rows of w are undefined VMEM too
+    return rowmask, jnp.where(rowmask > 0, w_ref[:], 0.0)
 
 
 def _bn_fwd_kernel(x_ref, w_ref, g_ref, b_ref, y_ref, st_ref, s1, s2, cnt, *,

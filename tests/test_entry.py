@@ -47,6 +47,20 @@ def test_train_classifier_fed_end_to_end(tmp_path):
     assert "test/Global-Accuracy" in result["logger_history"]
 
 
+def test_train_fed_sharded_placement(tmp_path):
+    """The full fed entry with cfg data_placement=sharded trains, evaluates
+    and checkpoints like the replicated default."""
+    from heterofl_tpu.entry import train_classifier_fed
+
+    argv = ["--control_name", "1_8_0.5_iid_fix_a1-b1_bn_1_1",
+            "--data_name", "MNIST", "--model_name", "conv"] \
+        + _override(tmp_path, {"data_placement": "sharded"})
+    res = train_classifier_fed.main(argv)
+    hist = res[0]["logger"].history
+    assert len(hist["test/Global-Accuracy"]) == 2
+    assert np.isfinite(hist["train/Local-Loss"]).all()
+
+
 def test_resume_modes(tmp_path):
     from heterofl_tpu.entry import train_classifier_fed
 

@@ -286,6 +286,34 @@ def test_sharded_placement_matches_replicated():
     assert np.asarray(ms1["n"]).sum() == np.asarray(ms2["n"]).sum()
 
 
+def test_sharded_placement_lm_matches_replicated():
+    """Sharded placement on the LM path: token-row stacks sharded over the
+    clients axis give the same round as replicated."""
+    from heterofl_tpu.parallel import shard_client_data
+
+    cfg, data = _lm_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(2, 1)
+    user_idx = np.arange(4)
+
+    p1 = model.init(jax.random.key(0))
+    out1, ms1 = RoundEngine(model, cfg, mesh).train_round(
+        p1, jax.random.key(5), 0.5, user_idx, data)
+
+    cfg2 = dict(cfg)
+    cfg2["data_placement"] = "sharded"
+    sharded = shard_client_data(mesh, data)
+    assert sharded[0].addressable_shards[0].data.shape[0] == 2
+    p2 = model.init(jax.random.key(0))
+    out2, ms2 = RoundEngine(model, cfg2, mesh).train_round(
+        p2, jax.random.key(5), 0.5, user_idx, sharded)
+
+    for k in out1:
+        np.testing.assert_allclose(np.asarray(out1[k]), np.asarray(out2[k]),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+    np.testing.assert_allclose(np.asarray(ms1["n"]).sum(), np.asarray(ms2["n"]).sum())
+
+
 def test_sharded_placement_unbalanced_and_padded():
     """Sharded placement with a non-divisible user count and an unbalanced
     active set (3 actives owned by one device) trains correctly; padded users
