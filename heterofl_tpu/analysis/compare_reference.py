@@ -149,6 +149,158 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
     return accs
 
 
+def _setup_lm(seed: int, users: int, n_train_tokens: int, n_test_tokens: int,
+              frac: float, local_epochs: int, bptt: int, batch_rows: int, dims):
+    """Synthetic-WikiText2 twin, batchified and iid-split over rows
+    (ref utils.py:100-110 + data.py:61-76: LM "labels" are the tokens)."""
+    from ..config import default_cfg, parse_control_name, process_control
+    from ..data import fetch_dataset, split_dataset
+    from ..data.pipeline import process_dataset
+
+    cfg = default_cfg()
+    cfg["control"] = parse_control_name(
+        f"1_{users}_{frac}_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    cfg["data_name"] = "WikiText2"
+    cfg["model_name"] = "transformer"
+    cfg = process_control(cfg)
+    cfg["transformer"] = dict(dims)
+    cfg["bptt"] = bptt
+    cfg["num_epochs"] = {"global": 1, "local": local_epochs}
+    cfg["batch_size"] = {"train": batch_rows, "test": batch_rows}
+    ds = fetch_dataset("WikiText2", synthetic=True, seed=seed,
+                       synthetic_sizes={"train": n_train_tokens, "test": n_test_tokens})
+    cfg, ds = process_dataset(cfg, ds)
+    rng = np.random.default_rng(seed)
+    split, lsplit = split_dataset(ds, users, "iid", rng)
+    return cfg, ds, split, lsplit
+
+
+def _patch_ref_encoder(tm):
+    """The reference targets torch 1.7; modern ``nn.TransformerEncoder``'s
+    fast-path probes ``layer.self_attn``, which its custom layer lacks.
+    Replace the encoder forward with the plain layer loop (identical
+    semantics)."""
+    import types
+
+    def plain_forward(self, src, mask=None, src_key_padding_mask=None):
+        out = src
+        for mod in self.layers:
+            out = mod(out, src_mask=mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+    tm.transformer_encoder.forward = types.MethodType(plain_forward, tm.transformer_encoder)
+    return tm
+
+
+def run_reference_lm(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[float]:
+    """The reference's transformer federated loop (train_transformer_fed.py:
+    100-183): per-user SGD over bptt windows of its rows, counted-average
+    combine, global perplexity each round (no sBN for LM)."""
+    import math
+
+    import torch
+
+    ref_cfg, ref_models, Federation = _import_reference()
+    V = cfg["num_tokens"]
+    ref_cfg.update({
+        "scale": True, "mask": True, "global_model_rate": 1.0,
+        "device": "cpu", "model_name": "transformer", "model_split_mode": "fix",
+        "model_rate": list(cfg["model_rate"]), "classes_size": V,
+        "num_tokens": V, "bptt": cfg["bptt"], "mask_rate": cfg["mask_rate"],
+        "transformer": dict(cfg["transformer"]), "world_size": 1,
+    })
+    factory = lambda model_rate: _patch_ref_encoder(
+        ref_models.transformer(model_rate=model_rate))
+    torch.manual_seed(seed)
+    model = factory(model_rate=1.0)
+    fed = Federation({k: v.clone() for k, v in model.state_dict().items()},
+                     list(cfg["model_rate"]), {i: lsplit[i] for i in lsplit})
+    rng = np.random.default_rng(seed + 77)  # user sampling: shared stream
+    users = cfg["num_users"]
+    n_active = int(np.ceil(cfg["frac"] * users))
+    rows_all = np.asarray(ds["train"].token, np.int64)
+    test_rows = torch.tensor(np.asarray(ds["test"].token, np.int64))
+    bptt = cfg["bptt"]
+    ppls = []
+    for r in range(rounds):
+        user_idx = rng.permutation(users)[:n_active].tolist()
+        local_params, param_idx = fed.distribute(user_idx)
+        for m, u in enumerate(user_idx):
+            rate = fed.model_rate[u]
+            tm = factory(model_rate=float(rate))
+            tm.load_state_dict(local_params[m])
+            tm.train(True)
+            opt = torch.optim.SGD(tm.parameters(), lr=lr, momentum=0.9,
+                                  weight_decay=5e-4)
+            urows = torch.tensor(rows_all[np.asarray(split["train"][u], np.int64)])
+            T = urows.shape[1]
+            for _ in range(cfg["num_epochs"]["local"]):
+                # BatchDataset(bptt) iteration order: sequential windows,
+                # short final window kept (ref data.py:136-150)
+                for s in range(0, T, bptt):
+                    inp = {"label": urows[:, s: s + bptt],
+                           "label_split": torch.tensor(lsplit[u])}
+                    opt.zero_grad()
+                    out = tm(inp)
+                    out["loss"].backward()
+                    torch.nn.utils.clip_grad_norm_(tm.parameters(), 1)
+                    opt.step()
+            local_params[m] = tm.state_dict()
+        fed.combine(local_params, param_idx, user_idx)
+        model.load_state_dict(fed.global_parameters)
+        model.train(False)
+        # Global-Perplexity: row-weighted mean of exp(window CE) over the
+        # batchified test stream (ref train_transformer_fed.py:127-143 with
+        # metrics.py:16-25); the masked-LM corruption stays on in eval (the
+        # reference quirk: Bernoulli draw is unconditional in forward)
+        with torch.no_grad():
+            tot = n = 0.0
+            Tt = test_rows.shape[1]
+            for s in range(0, Tt, bptt):
+                out = model({"label": test_rows[:, s: s + bptt]})
+                w = float(test_rows.shape[0])
+                tot += math.exp(float(out["loss"])) * w
+                n += w
+        ppls.append(tot / max(n, 1.0))
+    return ppls
+
+
+def run_mine_lm(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[float]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..data import label_split_masks
+    from ..data.pipeline import bptt_windows, stack_client_token_rows, stack_windows
+    from ..models import make_model
+    from ..parallel import RoundEngine, make_mesh
+    from ..parallel.evaluation import Evaluator
+
+    users = cfg["num_users"]
+    rows = stack_client_token_rows(np.asarray(ds["train"].token), split["train"],
+                                   list(range(users)))
+    lm = label_split_masks(lsplit, users, cfg["num_tokens"])
+    data = (jnp.asarray(rows), jnp.asarray(lm))
+    model = make_model(cfg)
+    params = model.init(jax.random.key(seed))
+    mesh = make_mesh(min(len(jax.devices()), users), 1)
+    eng = RoundEngine(model, cfg, mesh)
+    ev = Evaluator(model, cfg, mesh)
+    xs, ws = stack_windows(bptt_windows(np.asarray(ds["test"].token), cfg["bptt"]),
+                           cfg["bptt"])
+    rng = np.random.default_rng(seed + 77)
+    n_active = int(np.ceil(cfg["frac"] * users))
+    ppls = []
+    for r in range(rounds):
+        user_idx = rng.permutation(users)[:n_active].astype(np.int32)
+        params, _ = eng.train_round(params, jax.random.fold_in(jax.random.key(seed), r),
+                                    lr, user_idx, data)
+        g = ev.eval_global(params, {}, xs, ws)
+        ppls.append(float(g["score_sum"]) / max(float(g["n"]), 1.0))
+    return ppls
+
+
 def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[float]:
     import jax
     import jax.numpy as jnp
@@ -195,8 +347,18 @@ def main(argv=None):
     parser.add_argument("--lr", default=0.01, type=float)
     parser.add_argument("--seed", default=0, type=int)
     parser.add_argument("--out", default=None, type=str)
-    parser.add_argument("--model", default="conv", type=str, choices=["conv", "resnet18"])
-    parser.add_argument("--data", default="MNIST", type=str, choices=["MNIST", "CIFAR10"])
+    parser.add_argument("--model", default="conv", type=str,
+                        choices=["conv", "resnet18", "transformer"])
+    parser.add_argument("--data", default="MNIST", type=str,
+                        choices=["MNIST", "CIFAR10", "WikiText2"])
+    parser.add_argument("--bptt", default=16, type=int, help="LM window (transformer only)")
+    parser.add_argument("--batch_rows", default=20, type=int,
+                        help="LM batchify rows (transformer only)")
+    parser.add_argument("--n_test_tokens", default=4000, type=int, help="transformer only")
+    parser.add_argument("--emb", default=64, type=int,
+                        help="transformer embedding size (must give >= 1 dim per "
+                             "head at the smallest rate: emb*0.0625 >= heads)")
+    parser.add_argument("--layers", default=2, type=int, help="transformer layers")
     parser.add_argument("--frac", default=0.5, type=float)
     parser.add_argument("--split", default="iid", type=str,
                         help="iid or non-iid-N (ref src/data.py:79-110)")
@@ -204,18 +366,42 @@ def main(argv=None):
     parser.add_argument("--skip", default="", type=str,
                         help="'reference' or 'mine': emit only the other side")
     args = parser.parse_args(argv)
-    hidden = [int(h) for h in args.hidden.split(",")]
-    cfg, ds, split, lsplit = _setup(args.seed, args.users, hidden, args.n_train, args.n_test,
-                                    model_name=args.model, data_name=args.data,
-                                    frac=args.frac, split_mode=args.split,
-                                    local_epochs=args.local_epochs)
-    ref = [] if args.skip == "reference" else \
-        run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
-    mine = [] if args.skip == "mine" else \
-        run_mine(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
-    report = {"reference_acc": ref, "mine_acc": mine}
-    if ref and mine:
-        report["final_gap_pp"] = round(mine[-1] - ref[-1], 2)
+    if args.model == "transformer":
+        if args.split != "iid":
+            parser.error("--split is iid-only for transformer (the reference LM "
+                         "path has no non-iid mode, ref data.py:62-67)")
+        if args.emb * 0.0625 < 4:
+            parser.error(
+                f"--emb {args.emb} is too small: the smallest rate level (e=0.0625) "
+                f"must keep at least 1 dim per head (4 heads), i.e. emb >= 64 -- "
+                f"otherwise the reference's per-head q/k/v slicing degenerates")
+        dims = {"embedding_size": args.emb, "num_heads": 4,
+                "hidden_size": 2 * args.emb, "num_layers": args.layers,
+                "dropout": 0.2}
+        cfg, ds, split, lsplit = _setup_lm(args.seed, args.users, args.n_train,
+                                           args.n_test_tokens, args.frac,
+                                           args.local_epochs, args.bptt,
+                                           args.batch_rows, dims)
+        ref = [] if args.skip == "reference" else \
+            run_reference_lm(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+        mine = [] if args.skip == "mine" else \
+            run_mine_lm(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+        report = {"reference_ppl": ref, "mine_ppl": mine}
+        if ref and mine:
+            report["final_gap_ppl"] = round(mine[-1] - ref[-1], 2)
+    else:
+        hidden = [int(h) for h in args.hidden.split(",")]
+        cfg, ds, split, lsplit = _setup(args.seed, args.users, hidden, args.n_train, args.n_test,
+                                        model_name=args.model, data_name=args.data,
+                                        frac=args.frac, split_mode=args.split,
+                                        local_epochs=args.local_epochs)
+        ref = [] if args.skip == "reference" else \
+            run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+        mine = [] if args.skip == "mine" else \
+            run_mine(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+        report = {"reference_acc": ref, "mine_acc": mine}
+        if ref and mine:
+            report["final_gap_pp"] = round(mine[-1] - ref[-1], 2)
     print(json.dumps(report))
     if args.out:
         with open(args.out, "w") as f:

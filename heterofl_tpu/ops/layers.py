@@ -78,25 +78,6 @@ def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(x, axis=(1, 2))
 
 
-def _weighted_moments(x: jnp.ndarray, axes, weight: Optional[jnp.ndarray] = None,
-                      count=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Mean/biased-var over ``axes`` with optional per-element weight.
-
-    ``count`` overrides the effective element count (used by masked norms where
-    zero entries must not dilute the statistics).
-    """
-    if weight is None:
-        n = count if count is not None else jnp.prod(jnp.array([x.shape[a] for a in axes]))
-        mean = jnp.sum(x, axis=axes, keepdims=True) / n
-        var = jnp.sum((x - mean) ** 2 * 1.0, axis=axes, keepdims=True) / n
-        return mean, var, n
-    n = jnp.sum(weight, axis=axes, keepdims=True) if count is None else count
-    d = jnp.maximum(n, 1e-6)  # all-zero-weight (padded) batches: 0-stats, not NaN
-    mean = jnp.sum(x * weight, axis=axes, keepdims=True) / d
-    var = jnp.sum(weight * (x - mean) ** 2, axis=axes, keepdims=True) / d
-    return mean, var, n
-
-
 def batch_norm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray, *,
                mode: str = "batch",
                running: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
@@ -137,27 +118,30 @@ def batch_norm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray, *,
     if sample_weight is not None:
         w = sample_weight.reshape((-1,) + (1,) * (x.ndim - 1))
         w = jnp.broadcast_to(w, x.shape)
+    # One-pass (sum, sumsq, count) moments everywhere: the two independent
+    # reductions share one read of ``x`` (XLA multi-output fusion), where the
+    # two-pass mean-then-var form forces a second full pass; this is the
+    # hottest op in the round step (MEASUREMENTS.md: ~40% of step time).
+    # f32 accumulation keeps the E[x^2]-mean^2 cancellation benign at BN
+    # activation scales.
+    if w is None:
+        s1 = jnp.sum(x, axis=axes, keepdims=True, dtype=jnp.float32)
+        s2 = jnp.sum(x * x, axis=axes, keepdims=True, dtype=jnp.float32)
+        cnt = 1.0
+        for a in axes:
+            cnt *= x.shape[a]
+        n = jnp.asarray(cnt, jnp.float32)
+    else:
+        s1 = jnp.sum(x * w, axis=axes, keepdims=True, dtype=jnp.float32)
+        s2 = jnp.sum(w * x * x, axis=axes, keepdims=True, dtype=jnp.float32)
+        n = jnp.sum(w, axis=axes, keepdims=True, dtype=jnp.float32)
     if axis_name is not None:
-        # cross-device moments via (sum, sumsq, count) psums
-        if w is None:
-            s1 = jnp.sum(x, axis=axes, keepdims=True)
-            s2 = jnp.sum(x * x, axis=axes, keepdims=True)
-            cnt = 1.0
-            for a in axes:
-                cnt *= x.shape[a]
-            n = jnp.asarray(cnt, x.dtype)
-        else:
-            s1 = jnp.sum(x * w, axis=axes, keepdims=True)
-            s2 = jnp.sum(w * x * x, axis=axes, keepdims=True)
-            n = jnp.sum(w, axis=axes, keepdims=True)
         s1 = jax.lax.psum(s1, axis_name)
         s2 = jax.lax.psum(s2, axis_name)
-        n = jax.lax.psum(n, axis_name)
-        d = jnp.maximum(n, 1e-6)
-        mean = s1 / d
-        var = jnp.maximum(s2 / d - mean * mean, 0.0)
-    else:
-        mean, var, n = _weighted_moments(x, axes, w)
+        n = jax.lax.psum(n, axis_name) if w is not None else n * jax.lax.psum(1.0, axis_name)
+    d = jnp.maximum(n, 1e-6)
+    mean = s1 / d
+    var = jnp.maximum(s2 / d - mean * mean, 0.0)
     y = (x - mean) / jnp.sqrt(var + eps) * g + b
     if mode == "collect":
         unbiased = var * n / jnp.maximum(n - 1, 1)

@@ -1,29 +1,15 @@
 #!/bin/bash
 # CPU fallback for the mine-side trajectory runs (wedged TPU tunnel).
 # Waits for the torch-reference script to finish (single-core box), then
-# runs this framework's sides on the virtual CPU backend with a persistent
-# compilation cache.
+# delegates to run_parity_mine.py -- the single source of truth for the
+# run matrix -- on the virtual CPU backend with a persistent compilation
+# cache.
 set -u
 cd /root/repo
 # wait on the ref script's LAST output artifact (robust to where its log
 # was redirected), or its conventional log sentinel
 while ! { [ -s /tmp/PARITY_REF_MNIST_NONIID_S2.json ] \
           || grep -q ALL_REF_DONE /tmp/parity_ref.log 2>/dev/null; }; do sleep 60; done
-RUN() {
-  env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE -u AXON_LOOPBACK_RELAY \
-    JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR=/tmp/jaxcache PYTHONPATH=/root/repo \
-    python -u -m heterofl_tpu.analysis.compare_reference "$@"
-}
-for s in 0 1 2; do
-  echo "=== CIFAR resnet18 mine seed $s $(date -u +%H:%M:%S) ==="
-  RUN --data CIFAR10 --model resnet18 --hidden 64,128 --users 100 --frac 0.1 \
-      --rounds 25 --local_epochs 1 --n_train 2000 --n_test 1000 --seed $s \
-      --skip reference --out /tmp/PARITY_MINE_CIFAR_S$s.json 2>&1 | tail -1
-done
-for s in 0 1 2; do
-  echo "=== MNIST conv non-iid mine seed $s $(date -u +%H:%M:%S) ==="
-  RUN --data MNIST --model conv --hidden 64,128,256,512 --users 100 --frac 0.1 \
-      --split non-iid-2 --rounds 25 --local_epochs 5 --n_train 2000 --n_test 1000 \
-      --seed $s --skip reference --out /tmp/PARITY_MINE_MNIST_NONIID_S$s.json 2>&1 | tail -1
-done
-echo "=== ALL_MINE_DONE $(date -u +%H:%M:%S) ==="
+env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE -u AXON_LOOPBACK_RELAY \
+  JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR=/tmp/jaxcache PYTHONPATH=/root/repo \
+  python -u scripts/run_parity_mine.py

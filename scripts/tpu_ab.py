@@ -89,6 +89,40 @@ def main():
         return dt
 
     base = run("base_bf16_bn_aug_clip")
+
+    # A/B the one-pass (sum, sumsq) BN moments against the original two-pass
+    # mean-then-var form (r2 change in ops/layers.py:batch_norm): the two
+    # reductions of the one-pass form share a single read of x via XLA
+    # multi-output fusion, the two-pass form cannot.
+    import heterofl_tpu.models.norms as norms_mod
+
+    def batch_norm_two_pass(x, g, b, *, mode="batch", running=None,
+                            sample_weight=None, eps=1e-5, axis_name=None):
+        assert mode in ("batch", "collect") and axis_name is None
+        axes = tuple(range(x.ndim - 1))
+        if sample_weight is None:
+            n = 1.0
+            for a in axes:
+                n *= x.shape[a]
+            mean = jnp.sum(x, axis=axes, keepdims=True) / n
+            var = jnp.sum((x - mean) ** 2, axis=axes, keepdims=True) / n
+        else:
+            w = jnp.broadcast_to(
+                sample_weight.reshape((-1,) + (1,) * (x.ndim - 1)), x.shape)
+            n = jnp.sum(w, axis=axes, keepdims=True)
+            d = jnp.maximum(n, 1e-6)
+            mean = jnp.sum(x * w, axis=axes, keepdims=True) / d
+            var = jnp.sum(w * (x - mean) ** 2, axis=axes, keepdims=True) / d
+        y = (x - mean) / jnp.sqrt(var + eps) * g + b
+        return y, None
+
+    orig_bn = norms_mod.batch_norm
+    norms_mod.batch_norm = batch_norm_two_pass
+    try:
+        run("bn_two_pass_moments")
+    finally:
+        norms_mod.batch_norm = orig_bn
+
     run("no_augment", augment=False)
     run("no_clip", clip=False)
     run("no_augment_no_clip", augment=False, clip=False)

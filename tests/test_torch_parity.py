@@ -420,20 +420,10 @@ def test_transformer_forward_matches_reference(ref_modules, rate):
     tm.load_state_dict(_to_torch_transformer_state(use, 2), strict=True)
     tm.train(True)
 
-    # The reference targets torch 1.7; modern nn.TransformerEncoder's
-    # fast-path probes layer.self_attn which its custom layer lacks.  Replace
-    # the encoder forward with the plain layer loop (identical semantics).
-    import types
+    # torch-1.7 fast-path workaround, shared with the trajectory harness
+    from heterofl_tpu.analysis.compare_reference import _patch_ref_encoder
 
-    def plain_forward(self, src, mask=None, src_key_padding_mask=None):
-        out = src
-        for mod in self.layers:
-            out = mod(out, src_mask=mask)
-        if self.norm is not None:
-            out = self.norm(out)
-        return out
-
-    tm.transformer_encoder.forward = types.MethodType(plain_forward, tm.transformer_encoder)
+    _patch_ref_encoder(tm)
 
     rng = np.random.default_rng(11)
     labels = rng.integers(0, 50, (2, 16))
@@ -533,3 +523,100 @@ def test_full_round_matches_reference(ref_modules, family):
     for k in ref_new:
         np.testing.assert_allclose(ref_new[k], mine_sd[k].numpy(), rtol=2e-3, atol=2e-4,
                                    err_msg=f"{family}: {k}")
+
+
+def test_full_round_matches_reference_transformer(ref_modules):
+    """Transformer analogue of the deterministic full-round test: corruption
+    (mask_rate=0) and dropout off, windows iterate in order with no shuffle,
+    so the reference's distribute -> per-window torch SGD -> combine
+    (incl. the per-head q/k/v slicing, embedding column slice and the
+    label-split row restriction on decoder/embedding, ref fed.py:115-131,
+    263-274) must equal the jitted masked LM round parameter-for-parameter."""
+    from heterofl_tpu.data import label_split_masks
+    from heterofl_tpu.parallel import RoundEngine, make_mesh
+
+    ref_cfg, ref_models = ref_modules
+    sys.path.insert(0, REF)
+    try:
+        from fed import Federation
+    finally:
+        sys.path.remove(REF)
+
+    V, bptt, R, T = 50, 16, 2, 32
+    my_cfg = C.default_cfg()
+    my_cfg["control"] = C.parse_control_name("1_4_1_iid_fix_a1-b1_bn_1_1")
+    my_cfg["data_name"] = "WikiText2"
+    my_cfg["model_name"] = "transformer"
+    my_cfg = C.process_control(my_cfg)
+    my_cfg["transformer"] = {"embedding_size": 32, "num_heads": 4,
+                             "hidden_size": 64, "num_layers": 2, "dropout": 0.0}
+    my_cfg["bptt"] = bptt
+    my_cfg["mask_rate"] = 0.0
+    my_cfg["num_tokens"] = V
+    my_cfg["classes_size"] = V
+    my_cfg["num_users"] = 4
+    my_cfg["num_epochs"] = {"global": 1, "local": 1}
+    my_cfg["batch_size"] = {"train": 10, "test": 10}
+    my_cfg["optimizer_name"] = "SGD"
+    my_cfg["momentum"] = 0.9
+    my_cfg["weight_decay"] = 5e-4
+    rates = [1.0, 0.5, 0.25, 0.125]
+    my_cfg["model_rate"] = rates
+    lr = 0.05
+
+    ref_cfg["num_tokens"] = V
+    ref_cfg["bptt"] = bptt
+    ref_cfg["mask_rate"] = 0.0
+    ref_cfg["mask"] = True
+    ref_cfg["scale"] = True
+    ref_cfg["global_model_rate"] = 1.0
+    ref_cfg["classes_size"] = V
+    ref_cfg["transformer"] = dict(my_cfg["transformer"])
+    ref_cfg["model_name"] = "transformer"
+    ref_cfg["model_split_mode"] = "fix"
+    ref_cfg["model_rate"] = rates
+    ref_cfg["device"] = "cpu"
+
+    gm = make_model(my_cfg)
+    params = gm.init(jax.random.key(5))
+    pn = {k: np.asarray(v) for k, v in params.items()}
+
+    rng = np.random.default_rng(17)
+    rows = rng.integers(0, V, (4, R, T))
+    label_split = {i: sorted(set(rows[i].reshape(-1).tolist())) for i in range(4)}
+
+    # ---- reference round
+    from heterofl_tpu.analysis.compare_reference import _patch_ref_encoder
+
+    sd = _to_torch_transformer_state(pn, 2)
+    fed = Federation({k: v.clone() for k, v in sd.items()}, rates, label_split)
+    local_params, param_idx = fed.distribute([0, 1, 2, 3])
+    for m in range(4):
+        tm = _patch_ref_encoder(ref_models.transformer(model_rate=rates[m]))
+        tm.load_state_dict(local_params[m])
+        tm.train(True)
+        opt = torch.optim.SGD(tm.parameters(), lr=lr, momentum=0.9, weight_decay=5e-4)
+        urows = torch.tensor(rows[m])
+        for s in range(0, T, bptt):
+            inp = {"label": urows[:, s: s + bptt],
+                   "label_split": torch.tensor(label_split[m])}
+            opt.zero_grad()
+            out = tm(inp)
+            out["loss"].backward()
+            torch.nn.utils.clip_grad_norm_(tm.parameters(), 1)
+            opt.step()
+        local_params[m] = tm.state_dict()
+    fed.combine(local_params, param_idx, [0, 1, 2, 3])
+    ref_new = {k: v.numpy() for k, v in fed.global_parameters.items()}
+
+    # ---- my round
+    eng = RoundEngine(gm, my_cfg, make_mesh(1, 1))
+    lm = label_split_masks(label_split, 4, V)
+    data = (jnp.asarray(rows), jnp.asarray(lm))
+    new_params, _ = eng.train_round(params, jax.random.key(0), lr,
+                                    np.arange(4, dtype=np.int32), data)
+    mine = {k: np.asarray(v) for k, v in new_params.items()}
+    mine_sd = _to_torch_transformer_state(mine, 2)
+    for k in ref_new:
+        np.testing.assert_allclose(ref_new[k], mine_sd[k].numpy(), rtol=2e-3, atol=2e-4,
+                                   err_msg=k)
