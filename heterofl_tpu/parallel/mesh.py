@@ -53,7 +53,14 @@ def initialize_distributed() -> bool:
         return False
     import jax as _jax
 
-    # argless: jax reads JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
-    # JAX_PROCESS_ID / JAX_LOCAL_DEVICE_IDS itself, with its own diagnostics
-    _jax.distributed.initialize()
+    # jax.distributed.initialize() only auto-detects num_processes/process_id
+    # under a recognised cluster scheduler (SLURM & co.); on a hand-launched
+    # pod the documented env vars must be forwarded explicitly
+    num = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    _jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(num) if num is not None else None,
+        process_id=int(pid) if pid is not None else None,
+    )
     return True
