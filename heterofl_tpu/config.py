@@ -82,6 +82,9 @@ DEFAULT_CFG: Dict[str, Any] = {
     "data_placement": "replicated",
     # fuse the train-time masked BN into a Pallas TPU kernel (ops/pallas_norm.py)
     "pallas_norm": False,
+    # lax.scan unroll factor for the local-step loop (1 = no unrolling);
+    # latency-bound rounds can gain from fewer loop trips, A/B in tpu_ab.py
+    "scan_unroll": 1,
     "param_dtype": "float32",
     "compute_dtype": "float32",  # set "bfloat16" to run matmuls/convs in bf16
     "mesh": {"clients": 0, "data": 1},  # 0 => use all available devices

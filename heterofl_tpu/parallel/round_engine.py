@@ -96,6 +96,11 @@ class RoundEngine:
         self.placement = cfg.get("data_placement", "replicated")
         if self.placement not in ("replicated", "sharded"):
             raise ValueError(f"Not valid data_placement: {self.placement!r}")
+        # lax.scan unroll factor for the local-step loop: the round is
+        # latency-bound at HeteroFL's shapes (MEASUREMENTS.md), so fewer
+        # while-loop trips with more fusion scope per trip can shave per-step
+        # overhead; 1 = no unrolling (identical program)
+        self.scan_unroll = int(cfg.get("scan_unroll", 1) or 1)
         self._opt_init, self._opt_update = make_optimizer(cfg)
         self._train = None
         self._sbn = None
@@ -200,7 +205,8 @@ class RoundEngine:
             return (p, opt, acc), None
 
         acc0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
-        (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S))
+        (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S),
+                                      unroll=self.scan_unroll)
         return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
 
     def _local_train_lm(self, params, wr, rows, lm, key, lr, scaler_rate=None,
@@ -274,7 +280,8 @@ class RoundEngine:
             return (p, opt, acc), None
 
         acc0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
-        (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S))
+        (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S),
+                                      unroll=self.scan_unroll)
         return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
 
     # ------------------------------------------------------------------

@@ -9,7 +9,8 @@ cd /root/repo
 # upstream died without printing it, start anyway after the deadline (the LM
 # runs are independent of the vision artifacts).
 deadline=$(( $(date +%s) + ${PARITY_LM_WAIT_S:-28800} ))
-while ! grep -q ALL_MINE_DONE /tmp/parity_mine.log 2>/dev/null; do
+while ! { [ -s /tmp/PARITY_MINE_MNIST_NONIID_S2.json ] \
+          || grep -q ALL_MINE_DONE /tmp/parity_mine.log 2>/dev/null; }; do
   if [ "$(date +%s)" -ge "$deadline" ]; then
     echo "=== WAIT_TIMEOUT: starting LM runs without the vision sentinel ==="
     break

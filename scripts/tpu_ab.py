@@ -44,7 +44,7 @@ def main():
     data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
 
     def run(name, norm="bn", dtype="bfloat16", augment=True, clip=True,
-            pallas_norm=False):
+            pallas_norm=False, scan_unroll=1):
         cfg = C.default_cfg()
         cfg["control"] = C.parse_control_name(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_{norm}_1_1")
         cfg["data_name"] = "CIFAR10"
@@ -54,6 +54,7 @@ def main():
         cfg = C.process_control(cfg)
         cfg["classes_size"] = 10
         cfg["pallas_norm"] = pallas_norm
+        cfg["scan_unroll"] = scan_unroll
 
         orig_clip = re_mod.clip_by_global_norm
         orig_aug = re_mod.augment_cifar
@@ -123,6 +124,8 @@ def main():
     finally:
         norms_mod.batch_norm = orig_bn
 
+    run("scan_unroll_2", scan_unroll=2)
+    run("scan_unroll_4", scan_unroll=4)
     run("no_augment", augment=False)
     run("no_clip", clip=False)
     run("no_augment_no_clip", augment=False, clip=False)

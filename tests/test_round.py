@@ -338,3 +338,24 @@ def test_sharded_placement_unbalanced_and_padded():
     assert np.isfinite(ms["loss_sum"]).all()
     for k in out:
         assert np.isfinite(np.asarray(out[k])).all(), k
+
+
+def test_scan_unroll_equivalent():
+    """``scan_unroll`` is a pure perf knob: unrolled local-step loops (incl. a
+    non-dividing factor) give the same round up to XLA fusion reassociation."""
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    outs = []
+    for unroll in (1, 3):
+        cfg_u = dict(cfg)
+        cfg_u["scan_unroll"] = unroll
+        p = model.init(jax.random.key(0))
+        eng = RoundEngine(model, cfg_u, make_mesh(1, 1))
+        out, _ = eng.train_round(p, jax.random.key(3), 0.05,
+                                 np.arange(2, dtype=np.int32), data)
+        outs.append({k: np.asarray(v) for k, v in out.items()})
+    for k in outs[0]:
+        # fusion reassociation compounds over the local steps; a semantic bug
+        # (skipped/duplicated step) would show as O(1e-1) differences
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=2e-3, atol=5e-5,
+                                   err_msg=k)
