@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Merge the round-2 trajectory-parity artifacts (/tmp/PARITY_{REF,MINE}_*)
+into the repo's PARITY_RUN_*.json files.
+
+Each output file carries both trajectories plus the final-round gap; vision
+gaps in accuracy points (mine - ref, positive = mine ahead), LM gaps in
+perplexity (negative = mine ahead).  Run after the campaign scripts finish.
+"""
+
+import json
+import os
+
+PAIRS = [
+    # (ref artifact, mine artifact, repo output, kind)
+    *[(f"/tmp/PARITY_REF_CIFAR_S{s}.json", f"/tmp/PARITY_MINE_CIFAR_S{s}.json",
+       f"PARITY_RUN_CIFAR_RESNET_S{s}.json", "acc") for s in (0, 1, 2)],
+    *[(f"/tmp/PARITY_REF_MNIST_NONIID_S{s}.json", f"/tmp/PARITY_MINE_MNIST_NONIID_S{s}.json",
+       f"PARITY_RUN_MNIST_NONIID_S{s}.json", "acc") for s in (0, 1, 2)],
+    *[(f"/tmp/PARITY_LM_S{s}.json", None, f"PARITY_RUN_LM_S{s}.json", "ppl")
+      for s in (0, 1, 2)],
+]
+
+
+def main():
+    os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    done = []
+    for ref_p, mine_p, out_p, kind in PAIRS:
+        if not os.path.exists(ref_p):
+            print(f"skip {out_p}: missing {ref_p}")
+            continue
+        with open(ref_p) as f:
+            ref = json.load(f)
+        if mine_p is None:  # LM runs carry both sides in one artifact
+            rep = ref
+        else:
+            if not os.path.exists(mine_p):
+                print(f"skip {out_p}: missing {mine_p}")
+                continue
+            with open(mine_p) as f:
+                mine = json.load(f)
+            k = "reference_acc" if kind == "acc" else "reference_ppl"
+            km = "mine_acc" if kind == "acc" else "mine_ppl"
+            rep = {k: ref[k], km: mine[km]}
+        k = "reference_acc" if kind == "acc" else "reference_ppl"
+        km = "mine_acc" if kind == "acc" else "mine_ppl"
+        if rep.get(k) and rep.get(km):
+            gap_key = "final_gap_pp" if kind == "acc" else "final_gap_ppl"
+            rep[gap_key] = round(rep[km][-1] - rep[k][-1], 2)
+        with open(out_p, "w") as f:
+            json.dump(rep, f)
+        tail = {kk: ([round(v, 2) for v in vv[-3:]] if isinstance(vv, list) else vv)
+                for kk, vv in rep.items()}
+        print(f"{out_p}: {tail}")
+        done.append(out_p)
+    print(f"assembled {len(done)} files")
+
+
+if __name__ == "__main__":
+    main()
