@@ -118,30 +118,43 @@ def batch_norm(x: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray, *,
     if sample_weight is not None:
         w = sample_weight.reshape((-1,) + (1,) * (x.ndim - 1))
         w = jnp.broadcast_to(w, x.shape)
-    # One-pass (sum, sumsq, count) moments everywhere: the two independent
-    # reductions share one read of ``x`` (XLA multi-output fusion), where the
-    # two-pass mean-then-var form forces a second full pass; this is the
-    # hottest op in the round step (MEASUREMENTS.md: ~40% of step time).
-    # f32 accumulation keeps the E[x^2]-mean^2 cancellation benign at BN
-    # activation scales.
-    if w is None:
-        s1 = jnp.sum(x, axis=axes, keepdims=True, dtype=jnp.float32)
-        s2 = jnp.sum(x * x, axis=axes, keepdims=True, dtype=jnp.float32)
-        cnt = 1.0
-        for a in axes:
-            cnt *= x.shape[a]
-        n = jnp.asarray(cnt, jnp.float32)
-    else:
-        s1 = jnp.sum(x * w, axis=axes, keepdims=True, dtype=jnp.float32)
-        s2 = jnp.sum(w * x * x, axis=axes, keepdims=True, dtype=jnp.float32)
-        n = jnp.sum(w, axis=axes, keepdims=True, dtype=jnp.float32)
+    n_local = float(math.prod(x.shape[a] for a in axes))
     if axis_name is not None:
+        # Cross-device sync: one-pass (sum, sumsq, count) psums -- the only
+        # form expressible as single-shot collectives.
+        if w is None:
+            s1 = jnp.sum(x, axis=axes, keepdims=True, dtype=jnp.float32)
+            s2 = jnp.sum(x * x, axis=axes, keepdims=True, dtype=jnp.float32)
+            n = jnp.asarray(n_local, jnp.float32) * jax.lax.psum(1.0, axis_name)
+        else:
+            s1 = jnp.sum(x * w, axis=axes, keepdims=True, dtype=jnp.float32)
+            s2 = jnp.sum(w * x * x, axis=axes, keepdims=True, dtype=jnp.float32)
+            n = jax.lax.psum(jnp.sum(w, axis=axes, keepdims=True, dtype=jnp.float32),
+                             axis_name)
         s1 = jax.lax.psum(s1, axis_name)
         s2 = jax.lax.psum(s2, axis_name)
-        n = jax.lax.psum(n, axis_name) if w is not None else n * jax.lax.psum(1.0, axis_name)
-    d = jnp.maximum(n, 1e-6)
-    mean = s1 / d
-    var = jnp.maximum(s2 / d - mean * mean, 0.0)
+        d = jnp.maximum(n, 1e-6)
+        mean = s1 / d
+        var = jnp.maximum(s2 / d - mean * mean, 0.0)
+    else:
+        # Single-device: two-pass mean-then-centered-var (torch parity form).
+        # The one-pass E[x^2]-mean^2 alternative was A/B'd on TPU and is
+        # perf-neutral (19.71 vs 19.85 ms/step, MEASUREMENTS.md) -- XLA's
+        # fusion makes the second read ~free at these shapes -- while its
+        # uncentered sums are measurably more reduction-order-sensitive
+        # (masked-vs-sliced divergence grows ~5x), so the tighter two-pass
+        # form wins.
+        if w is None:
+            n = jnp.asarray(n_local, jnp.float32)
+            mean = jnp.sum(x, axis=axes, keepdims=True, dtype=jnp.float32) / n
+            var = jnp.sum((x - mean) ** 2, axis=axes, keepdims=True,
+                          dtype=jnp.float32) / n
+        else:
+            n = jnp.sum(w, axis=axes, keepdims=True, dtype=jnp.float32)
+            d = jnp.maximum(n, 1e-6)  # all-padding batches: 0-stats, not NaN
+            mean = jnp.sum(x * w, axis=axes, keepdims=True, dtype=jnp.float32) / d
+            var = jnp.sum(w * (x - mean) ** 2, axis=axes, keepdims=True,
+                          dtype=jnp.float32) / d
     y = (x - mean) / jnp.sqrt(var + eps) * g + b
     if mode == "collect":
         unbiased = var * n / jnp.maximum(n - 1, 1)
