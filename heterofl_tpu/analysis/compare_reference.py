@@ -42,13 +42,14 @@ def _import_reference():
 
 def _setup(seed: int, users: int, hidden, n_train: int, n_test: int,
            model_name: str = "conv", data_name: str = "MNIST", frac: float = 0.5,
-           split_mode: str = "iid", local_epochs: int = 1):
+           split_mode: str = "iid", local_epochs: int = 1,
+           mode: str = "a1-b1-c1-d1-e1", model_split: str = "fix"):
     from ..config import default_cfg, parse_control_name, process_control
     from ..data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
 
     cfg = default_cfg()
     cfg["control"] = parse_control_name(
-        f"1_{users}_{frac}_{split_mode}_fix_a1-b1-c1-d1-e1_bn_1_1")
+        f"1_{users}_{frac}_{split_mode}_{model_split}_{mode}_bn_1_1")
     cfg["data_name"] = data_name
     cfg["model_name"] = model_name
     cfg = process_control(cfg)
@@ -84,8 +85,17 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
         "norm": "bn", "scale": True, "mask": True, "global_model_rate": 1.0,
         "classes_size": 10, "conv": dict(cfg["conv"]), "resnet": dict(cfg["resnet"]),
         "data_shape": [c, h, w],
-        "device": "cpu", "model_name": model_name, "model_split_mode": "fix",
+        "device": "cpu", "model_name": model_name,
+        # dynamic mode: Federation.distribute() re-rolls per-user rates from
+        # cfg['proportion'] every round (ref fed.py:15-23,162); fix mode uses
+        # the static per-user vector.  model_rate carries the level list in
+        # dynamic mode and the per-user vector in fix mode, both sides
+        # identically (ref utils.py:127-145 == config.py:189-199).
+        "model_split_mode": cfg["model_split_mode"],
+        "num_users": cfg["num_users"],
         "model_rate": list(cfg["model_rate"]),
+        **({"proportion": list(cfg["proportion"])}
+           if cfg["model_split_mode"] == "dynamic" else {}),
     })
     factory = getattr(ref_models, model_name)
     mean = np.asarray(cfg["norm_stats"][0], np.float32)
@@ -296,7 +306,7 @@ def run_mine_lm(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> Li
         user_idx = rng.permutation(users)[:n_active].astype(np.int32)
         params, _ = eng.train_round(params, jax.random.fold_in(jax.random.key(seed), r),
                                     lr, user_idx, data)
-        g = ev.eval_global(params, {}, xs, ws)
+        g = ev.eval_global(params, {}, xs, ws, epoch=r)
         ppls.append(float(g["score_sum"]) / max(float(g["n"]), 1.0))
     return ppls
 
@@ -362,11 +372,23 @@ def main(argv=None):
     parser.add_argument("--frac", default=0.5, type=float)
     parser.add_argument("--split", default="iid", type=str,
                         help="iid or non-iid-N (ref src/data.py:79-110)")
+    parser.add_argument("--mode", default="a1-b1-c1-d1-e1", type=str,
+                        help="model_mode control field, e.g. a1-b9 / a5-e5 "
+                             "(ref src/make.py:55-66 interpolation grids)")
+    parser.add_argument("--model_split", default="fix", type=str,
+                        choices=["fix", "dynamic"],
+                        help="fix: static per-user rates; dynamic: re-rolled "
+                             "per round (ref fed.py:15-23)")
     parser.add_argument("--local_epochs", default=1, type=int)
     parser.add_argument("--skip", default="", type=str,
                         help="'reference' or 'mine': emit only the other side")
     args = parser.parse_args(argv)
     if args.model == "transformer":
+        # vision-only flags are ignored on the LM path -- loudly, not silently
+        for flag, attr in (("--n_test", "n_test"), ("--hidden", "hidden")):
+            if getattr(args, attr) != parser.get_default(attr):
+                print(f"warning: {flag} is ignored for --model transformer "
+                      f"(use --n_test_tokens / --emb instead)", file=sys.stderr)
         if args.split != "iid":
             parser.error("--split is iid-only for transformer (the reference LM "
                          "path has no non-iid mode, ref data.py:62-67)")
@@ -394,7 +416,8 @@ def main(argv=None):
         cfg, ds, split, lsplit = _setup(args.seed, args.users, hidden, args.n_train, args.n_test,
                                         model_name=args.model, data_name=args.data,
                                         frac=args.frac, split_mode=args.split,
-                                        local_epochs=args.local_epochs)
+                                        local_epochs=args.local_epochs,
+                                        mode=args.mode, model_split=args.model_split)
         ref = [] if args.skip == "reference" else \
             run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
         mine = [] if args.skip == "mine" else \

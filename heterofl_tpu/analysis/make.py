@@ -89,15 +89,24 @@ def build_controls(model: str, fed: int, data_split_mode: str, ablation: bool = 
 def make_script(run: str, model: str, fed: int, data_split_mode: str, *,
                 init_seed: int = 0, num_experiments: int = 1, experiment_step: int = 1,
                 resume_mode: int = 0, round_size: int = 1, hosts: List[str] = (),
-                ablation: bool = False, synthetic: bool = False) -> str:
+                ablation: bool = False, synthetic: bool = False,
+                modes: List[str] = (), extra_args: str = "") -> str:
+    """``modes``: optional model_mode whitelist (6th control field) to carve a
+    small-scale slice of the grid; ``extra_args``: verbatim CLI suffix for
+    every job (e.g. ``--output_dir ... --override '{...}'``)."""
     data_name, family = MODEL_TABLE[model]
     suffix = "_fed" if fed == 1 else ""
     module = f"heterofl_tpu.entry.{run}_{family}{suffix}"
     controls = build_controls(model, fed, data_split_mode if fed else "none", ablation)
+    if modes:
+        want = set(modes)
+        controls = [c for c in controls if c.split("_")[5] in want]
     seeds = list(range(init_seed, init_seed + num_experiments, experiment_step))
     lines = ["#!/bin/bash"]
     k = 0
     extra = " --synthetic 1" if synthetic else ""
+    if extra_args:
+        extra += " " + extra_args.strip()
     for seed in seeds:
         for ctl in controls:
             prefix = f"HOST={hosts[k % len(hosts)]} " if hosts else ""
@@ -128,12 +137,19 @@ def main(argv=None):
     parser.add_argument("--hosts", default="", type=str, help="comma-separated host list")
     parser.add_argument("--ablation", action="store_true")
     parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--modes", default="", type=str,
+                        help="comma-separated model_mode whitelist (e.g. "
+                             "'a1,b1,a5-b5') for a small-scale grid slice")
+    parser.add_argument("--extra", default="", type=str,
+                        help="verbatim CLI suffix appended to every job")
     args = parser.parse_args(argv)
     s = make_script(args.run, args.model, args.fed, args.data_split_mode,
                     init_seed=args.init_seed, num_experiments=args.num_experiments,
                     experiment_step=args.experiment_step, resume_mode=args.resume_mode,
                     round_size=args.round, hosts=[h for h in args.hosts.split(",") if h],
-                    ablation=args.ablation, synthetic=args.synthetic)
+                    ablation=args.ablation, synthetic=args.synthetic,
+                    modes=[m for m in args.modes.split(",") if m],
+                    extra_args=args.extra)
     name = f"{args.run}_{args.model}_{args.data_split_mode if args.fed else 'none'}"
     if args.ablation:
         name += "_ablation"

@@ -27,14 +27,51 @@ METRIC_KEYS = ("Global-Accuracy", "Global-Perplexity", "Global-Loss",
 
 
 def parse_tag(tag: str) -> Optional[Dict[str, str]]:
-    """Invert ``make_model_tag``: seed_data_subset_model_<9 control fields>."""
+    """Invert ``make_model_tag``: ``seed_data[_subset]_model_<9 control fields>``.
+
+    Anchored from the right at the exact control-field count
+    (``len(C.CONTROL_KEYS)``), with each anchor field validated against its
+    known domain so an underscored data name can never silently shift fields;
+    the model name is anchored by registry membership (``MODEL_NAMES``), which
+    keeps multi-part data names (e.g. ``Stacked_MNIST``) intact rather than
+    mislabelling them.  Returns ``None`` for tags that fail validation.
+    """
     parts = tag.split("_")
-    if len(parts) < 4 + len(C.CONTROL_KEYS):
+    n_ctl = len(C.CONTROL_KEYS)
+    if len(parts) < 3 + n_ctl:
         return None
-    ctl = dict(zip(C.CONTROL_KEYS, parts[-len(C.CONTROL_KEYS):]))
-    head = parts[: -len(C.CONTROL_KEYS)]
-    return {"seed": head[0], "data_name": head[1],
-            "subset": head[2] if len(head) > 3 else "",
+    ctl = dict(zip(C.CONTROL_KEYS, parts[-n_ctl:]))
+    # validate the control anchor: any mismatch means the tag is not ours (or
+    # an underscored field shifted the split) -- refuse rather than mislabel
+    try:
+        int(ctl["num_users"])
+        float(ctl["frac"])
+    except ValueError:
+        return None
+    if (ctl["fed"] not in ("0", "1") or ctl["norm"] not in C.NORM_TYPES
+            or ctl["model_split_mode"] not in ("fix", "dynamic")
+            or ctl["scale"] not in ("0", "1") or ctl["mask"] not in ("0", "1")):
+        return None
+    head = parts[:-n_ctl]
+    try:
+        int(head[0])
+    except ValueError:
+        return None
+    if head[-1] not in C.MODEL_NAMES:
+        return None
+    mid = head[1:-1]  # data name parts + optional subset
+    if not mid:
+        return None
+    # subset is a single token when present; prefer interpreting the last mid
+    # token as subset only when the remaining prefix is a known dataset name
+    DATASET_NAMES = C.VISION_DATASETS + C.FOLDER_DATASETS + C.LM_DATASETS
+    if len(mid) >= 2 and "_".join(mid[:-1]) in DATASET_NAMES:
+        data_name, subset = "_".join(mid[:-1]), mid[-1]
+    else:
+        # unknown dataset: keep the multi-token name intact rather than
+        # splitting off a spurious "subset" from its tail
+        data_name, subset = "_".join(mid), ""
+    return {"seed": head[0], "data_name": data_name, "subset": subset,
             "model_name": head[-1], **ctl}
 
 

@@ -34,6 +34,16 @@ CONTROL_KEYS = (
     "mask",
 )
 
+# Canonical name registries, kept here (jax-free) so offline analysis tooling
+# can validate tags without importing the model/data stacks.  models/ and
+# data/ import these rather than re-declaring them.
+NORM_TYPES = ("bn", "in", "ln", "gn", "none")
+MODEL_NAMES = ("conv", "resnet18", "resnet34", "resnet50", "resnet101",
+               "resnet152", "transformer")
+VISION_DATASETS = ("MNIST", "FashionMNIST", "EMNIST", "CIFAR10", "CIFAR100")
+FOLDER_DATASETS = ("Omniglot", "ImageNet", "ImageFolder")
+LM_DATASETS = ("PennTreebank", "WikiText2", "WikiText103")
+
 # Defaults mirroring the reference's config.yml (src/config.yml:1-55), minus
 # torch-isms. ``device`` keeps its role as an execution hint ("tpu"/"cpu").
 DEFAULT_CFG: Dict[str, Any] = {

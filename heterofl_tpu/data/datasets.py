@@ -451,9 +451,9 @@ def synthetic_lm(data_name: str, split: str, n_tokens: int = 200_000, vocab_size
 # Registry
 # ---------------------------------------------------------------------------
 
-VISION_DATASETS = ("MNIST", "FashionMNIST", "EMNIST", "CIFAR10", "CIFAR100")
-FOLDER_DATASETS = ("Omniglot", "ImageNet", "ImageFolder")
-LM_DATASETS = ("PennTreebank", "WikiText2", "WikiText103")
+# canonical registries live in config (jax-free); re-exported here for the
+# loaders' callers
+from ..config import FOLDER_DATASETS, LM_DATASETS, VISION_DATASETS  # noqa: E402,F401
 
 
 def fetch_dataset(data_name: str, data_dir: str = "./data", synthetic: bool = False,

@@ -218,7 +218,7 @@ class CentralExperiment:
             bn = {}
             if self.kind == "vision":
                 bn = self.evaluator.sbn_stats(params, *sbn_batches)
-            g = self.evaluator.eval_global(params, bn, *geval)
+            g = self.evaluator.eval_global(params, bn, *geval, epoch=epoch)
             named_g = summarize_sums({k: np.asarray(v) for k, v in g.items()},
                                      cfg["model_name"], prefix="")
             logger.append(named_g, "test", n=g["n"])

@@ -268,12 +268,12 @@ class FedExperiment:
         if self.kind == "vision":
             bn = self.evaluator.sbn_stats(params, *self.sbn_batches)
             xu, yu, mu, lm = self.local_eval
-            local = self.evaluator.eval_users(params, bn, xu, yu, mu, lm)
+            local = self.evaluator.eval_users(params, bn, xu, yu, mu, lm, epoch=epoch)
             named_local = summarize_sums(local, cfg["model_name"])
             logger.append(named_local, "test", n=float(np.sum(local["n"])))
-            g = self.evaluator.eval_global(params, bn, *self.global_eval)
+            g = self.evaluator.eval_global(params, bn, *self.global_eval, epoch=epoch)
         else:
-            g = self.evaluator.eval_global(params, {}, *self.global_eval)
+            g = self.evaluator.eval_global(params, {}, *self.global_eval, epoch=epoch)
         named_global = summarize_sums({k: np.asarray(v) for k, v in g.items()},
                                       cfg["model_name"], prefix="Global-")
         logger.append(named_global, "test", n=g["n"])
@@ -317,7 +317,11 @@ class FedExperiment:
             if "epoch" in blob:
                 last_epoch = blob["epoch"]
                 pivot = blob.get("pivot", pivot)
-                logger.history = blob.get("logger_history", logger.history)
+                if blob.get("logger_state"):
+                    # full fidelity: running means/counters + TB step counters
+                    logger.load_state_dict(blob["logger_state"])
+                else:  # older blobs carried history only
+                    logger.history = blob.get("logger_history", logger.history)
                 if blob.get("scheduler_state") and hasattr(self.scheduler, "load_state_dict"):
                     self.scheduler.load_state_dict(blob["scheduler_state"])
         n_rounds = cfg["num_epochs"]["global"]
@@ -350,6 +354,7 @@ class FedExperiment:
                 "bn_state": getattr(self, "bn_state", {}),
                 "pivot": pivot,
                 "logger_history": dict(logger.history),
+                "logger_state": logger.state_dict(),
                 "scheduler_state": self.scheduler.state_dict()
                 if hasattr(self.scheduler, "state_dict") else None,
             }

@@ -35,7 +35,11 @@ def evaluate_experiment(cfg: Dict[str, Any], seed: int, load_tag: str = "best") 
     exp.stage(data_split, label_split)
     logger = Logger(os.path.join(cfg["output_dir"], "runs", f"test_{exp.tag}"))
     logger.safe(True)
-    named_global = exp.evaluate(params, blob.get("epoch", 0), logger, label_split)
+    # checkpoints store the *resume* epoch (epoch+1); the eval RNG must reuse
+    # the epoch the checkpoint was evaluated at during training, or the
+    # re-evaluated LM metrics won't reproduce the logged ones
+    ckpt_epoch = max(int(blob.get("epoch") or 1) - 1, 0)
+    named_global = exp.evaluate(params, ckpt_epoch, logger, label_split)
     logger.safe(False)
     result = {
         "cfg": {k: v for k, v in exp.cfg.items() if k != "vocab"},
@@ -59,16 +63,18 @@ def _evaluate_central(cfg: Dict[str, Any], seed: int, load_tag: str) -> Dict[str
     cfg = exp.cfg
     blob = load_checkpoint(checkpoint_path(cfg["output_dir"], exp.tag, load_tag))
     params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+    # stored epoch is the resume epoch (epoch+1); rewind to the evaluated one
+    ep = max(int(blob.get("epoch") or 1) - 1, 0)
     if exp.kind == "vision":
         xs, ws = _batch_pad(exp.dataset["train"].data, cfg["batch_size"]["train"])
         bn = exp.evaluator.sbn_stats(params, xs, ws)
         te = exp.dataset["test"]
         xg, wg = _batch_pad(te.data, cfg["batch_size"]["test"])
         yg, _ = _batch_pad(te.target, cfg["batch_size"]["test"])
-        g = exp.evaluator.eval_global(params, bn, xg, yg, wg)
+        g = exp.evaluator.eval_global(params, bn, xg, yg, wg, epoch=ep)
     else:
         xs, ws = _stack_windows(bptt_windows(exp.dataset["test"].token, cfg["bptt"]), cfg["bptt"])
-        g = exp.evaluator.eval_global(params, {}, xs, ws)
+        g = exp.evaluator.eval_global(params, {}, xs, ws, epoch=ep)
     named = summarize_sums({k: np.asarray(v) for k, v in g.items()}, cfg["model_name"], prefix="")
     result = {"cfg": {k: v for k, v in cfg.items() if k != "vocab"},
               "epoch": blob.get("epoch"), "metrics": named,

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from ..config import ceil_width, scaled_hidden
+from ..config import MODEL_NAMES, ceil_width, scaled_hidden  # noqa: F401
 from .base import ModelDef  # noqa: F401
 from .conv import make_conv
 from .resnet import make_resnet
@@ -30,7 +30,9 @@ RESNET_BLOCKS = {
     "resnet152": ([3, 8, 36, 3], True),
 }
 
-MODEL_NAMES = ("conv",) + tuple(RESNET_BLOCKS) + ("transformer",)
+# the canonical registry lives in config (jax-free for analysis tooling);
+# keep it in lockstep with the families actually buildable here
+assert MODEL_NAMES == ("conv",) + tuple(RESNET_BLOCKS) + ("transformer",)
 
 
 def parse_compute_dtype(cd):

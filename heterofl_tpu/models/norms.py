@@ -12,9 +12,8 @@ from typing import Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
+from ..config import NORM_TYPES  # noqa: F401  (canonical registry, re-exported)
 from ..ops.layers import batch_norm, dynamic_group_norm
-
-NORM_TYPES = ("bn", "in", "ln", "gn", "none")
 
 
 def norm_has_params(norm_type: str) -> bool:

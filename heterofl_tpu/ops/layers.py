@@ -15,6 +15,7 @@ kernels -- the native layouts for XLA:TPU.
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax

@@ -146,9 +146,14 @@ class Evaluator:
                         out_specs=P("clients"))
         return jax.jit(fn)
 
-    def eval_users(self, params, bn_state, x, y, m, lm):
+    def eval_users(self, params, bn_state, x, y, m, lm, epoch: int = 0):
         """Per-user "Local" metrics: ``x [U, S, B, ...]`` batched test shards,
-        label masks ``lm [U, classes]``.  Returns per-user metric sums."""
+        label masks ``lm [U, classes]``.  Returns per-user metric sums.
+
+        ``epoch`` seeds the eval RNG (LM token corruption) so noise is fresh
+        each round, matching the reference's per-pass Bernoulli draws
+        (ref ``src/models/transformer.py:148-151``) while staying reproducible.
+        """
         if self._users is None:
             self._users = self._build_users()
         n_dev = self.mesh.shape["clients"]
@@ -160,7 +165,8 @@ class Evaluator:
             y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
             m = np.concatenate([m, np.zeros((pad,) + m.shape[1:], np.float32)])
             lm = np.concatenate([lm, np.zeros((pad,) + lm.shape[1:], np.float32)])
-        out = self._users(params, bn_state, jax.random.key(0), jnp.asarray(valid),
+        key = jax.random.fold_in(jax.random.key(0), epoch)
+        out = self._users(params, bn_state, key, jnp.asarray(valid),
                           jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
         return {k: np.asarray(v)[:u] for k, v in out.items()}
 
@@ -198,9 +204,12 @@ class Evaluator:
                         out_specs=P())
         return jax.jit(fn)
 
-    def eval_global(self, params, bn_state, *batched):
+    def eval_global(self, params, bn_state, *batched, epoch: int = 0):
         """"Global" metrics over the full test set: vision
-        ``(x [S,B,...], y [S,B], w [S,B])``; LM ``(rows [S,R,bptt], w)``."""
+        ``(x [S,B,...], y [S,B], w [S,B])``; LM ``(rows [S,R,bptt], w)``.
+
+        ``epoch`` seeds the eval RNG so LM corruption noise differs round to
+        round (ref ``src/models/transformer.py:148-151``)."""
         if self._global is None:
             self._global = self._build_global()
         n_dev = self.mesh.devices.size
@@ -211,5 +220,6 @@ class Evaluator:
             if pad:
                 arr = np.concatenate([arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
             padded.append(jnp.asarray(arr))
-        out = self._global(params, bn_state, jax.random.key(1), *padded)
+        key = jax.random.fold_in(jax.random.key(1), epoch)
+        out = self._global(params, bn_state, key, *padded)
         return {k: float(v) for k, v in out.items()}

@@ -44,7 +44,8 @@ def initialize_distributed() -> bool:
 
     Reads the standard ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
     ``JAX_PROCESS_ID`` variables (no-op when absent -- single-host runs and
-    TPU pod auto-detection need nothing).  Returns True if initialised.
+    TPU pod auto-detection need nothing), plus optional
+    ``JAX_LOCAL_DEVICE_IDS`` (comma-separated).  Returns True if initialised.
     """
     import os
 
@@ -55,12 +56,20 @@ def initialize_distributed() -> bool:
 
     # jax.distributed.initialize() only auto-detects num_processes/process_id
     # under a recognised cluster scheduler (SLURM & co.); on a hand-launched
-    # pod the documented env vars must be forwarded explicitly
+    # pod the documented env vars must be forwarded explicitly -- and must be
+    # set *together*: a half-specified pair fails deep inside the runtime with
+    # a confusing error, so validate here
     num = os.environ.get("JAX_NUM_PROCESSES")
     pid = os.environ.get("JAX_PROCESS_ID")
+    if (num is None) != (pid is None):
+        raise RuntimeError(
+            "JAX_NUM_PROCESSES and JAX_PROCESS_ID must be set together "
+            f"(got JAX_NUM_PROCESSES={num!r}, JAX_PROCESS_ID={pid!r})")
+    local = os.environ.get("JAX_LOCAL_DEVICE_IDS")
     _jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=int(num) if num is not None else None,
         process_id=int(pid) if pid is not None else None,
+        local_device_ids=[int(x) for x in local.split(",")] if local else None,
     )
     return True

@@ -55,6 +55,21 @@ class Logger:
         self.counter = defaultdict(float)
         self.mean = defaultdict(float)
 
+    # -- persistence (ref utils.py:302-312 pickles the whole Logger; here the
+    # state rides inside the checkpoint blob so resume-mode 1 restores running
+    # means/counters and TB step counters, not just history) ---------------
+    def state_dict(self) -> Dict[str, object]:
+        return {"counter": dict(self.counter), "mean": dict(self.mean),
+                "history": {k: list(v) for k, v in self.history.items()},
+                "iterator": dict(self.iterator)}
+
+    def load_state_dict(self, state: Dict[str, object]) -> None:
+        self.counter = defaultdict(float, state.get("counter", {}))
+        self.mean = defaultdict(float, state.get("mean", {}))
+        self.history = defaultdict(list, {k: list(v)
+                                          for k, v in state.get("history", {}).items()})
+        self.iterator = defaultdict(int, state.get("iterator", {}))
+
     # -- accumulation -------------------------------------------------
     def append(self, result: Dict[str, object], tag: str, n: float = 1, mean: bool = True) -> None:
         for k, v in result.items():
@@ -82,6 +97,11 @@ class Logger:
         line_items[2:2] = parts
         line = "  ".join(line_items) if line_items else "  ".join(parts)
         print(line)
+        if self.writer is not None:
+            # info line to the TB text channel (ref logger.py:81-83)
+            name = f"{tag}/info"
+            self.iterator[name] += 1
+            self.writer.add_text(name, line, self.iterator[name])
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(record) + "\n")
             self._jsonl.flush()

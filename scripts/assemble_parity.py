@@ -30,6 +30,8 @@ def main():
             continue
         with open(ref_p) as f:
             ref = json.load(f)
+        k = "reference_acc" if kind == "acc" else "reference_ppl"
+        km = "mine_acc" if kind == "acc" else "mine_ppl"
         if mine_p is None:  # LM runs carry both sides in one artifact
             rep = ref
         else:
@@ -38,11 +40,7 @@ def main():
                 continue
             with open(mine_p) as f:
                 mine = json.load(f)
-            k = "reference_acc" if kind == "acc" else "reference_ppl"
-            km = "mine_acc" if kind == "acc" else "mine_ppl"
             rep = {k: ref[k], km: mine[km]}
-        k = "reference_acc" if kind == "acc" else "reference_ppl"
-        km = "mine_acc" if kind == "acc" else "mine_ppl"
         if rep.get(k) and rep.get(km):
             gap_key = "final_gap_pp" if kind == "acc" else "final_gap_ppl"
             rep[gap_key] = round(rep[km][-1] - rep[k][-1], 2)

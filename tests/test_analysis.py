@@ -87,6 +87,24 @@ def test_module_table_params_complete_all_families():
         assert all(r[4] >= 0 for r in rows)
 
 
+def test_parse_tag_hardened():
+    ctl = "1_8_0.5_iid_fix_a1_bn_1_1"
+    # canonical: with + without subset
+    m = parse_tag(f"0_MNIST_label_conv_{ctl}")
+    assert m["data_name"] == "MNIST" and m["subset"] == "label" and m["model_name"] == "conv"
+    m = parse_tag(f"0_WikiText2_transformer_{ctl}")
+    assert m["data_name"] == "WikiText2" and m["subset"] == "" and m["model_name"] == "transformer"
+    # underscored data name must not shift fields: model anchors by registry
+    m = parse_tag(f"3_My_Custom_Data_conv_{ctl}")
+    assert m is not None and m["model_name"] == "conv" and m["seed"] == "3"
+    assert m["data_name"] == "My_Custom_Data" and m["subset"] == "" and m["fed"] == "1"
+    # junk is refused, not mislabelled
+    assert parse_tag("not_a_tag") is None
+    assert parse_tag(f"x_MNIST_label_conv_{ctl}") is None  # non-int seed
+    assert parse_tag(f"0_MNIST_label_notamodel_{ctl}") is None  # unknown model
+    assert parse_tag("0_MNIST_label_conv_1_8_0.5_iid_fix_a1_zz_1_1") is None  # bad norm
+
+
 def test_process_aggregation(tmp_path):
     os.makedirs(tmp_path / "result")
     for seed in (0, 1):

@@ -75,6 +75,32 @@ def test_resume_modes(tmp_path):
     assert len(res2[0]["logger"].history["test/Global-Accuracy"]) == 2
 
 
+def test_resume_logger_fidelity(tmp_path):
+    """Resume-mode 1 restores the FULL logger state (running means, counters,
+    TB step counters, history), not just history -- matching the reference,
+    which pickles the whole Logger into the checkpoint (ref
+    utils.py:302-312)."""
+    from heterofl_tpu.entry import train_classifier_fed
+    from heterofl_tpu.utils import load_checkpoint
+
+    argv = ["--control_name", "1_4_0.5_iid_fix_a1_bn_1_1",
+            "--data_name", "MNIST", "--model_name", "conv"] + _override(tmp_path)
+    train_classifier_fed.main(argv)
+    tag = "0_MNIST_label_conv_1_4_0.5_iid_fix_a1_bn_1_1"
+    blob = load_checkpoint(str(tmp_path / "model" / f"{tag}_checkpoint.pkl"))
+    st = blob["logger_state"]
+    # pre-reset snapshot (iterator only counts with a live TB writer)
+    assert st["counter"] and st["mean"]
+    assert len(st["history"]["test/Global-Accuracy"]) == 2
+    # a resumed run (no rounds left) carries the state forward verbatim
+    res = train_classifier_fed.main(argv + ["--resume_mode", "1"])
+    lg = res[0]["logger"]
+    assert dict(lg.counter) == st["counter"]
+    assert dict(lg.mean) == st["mean"]
+    assert dict(lg.iterator) == st["iterator"]
+    assert {k: list(v) for k, v in lg.history.items()} == st["history"]
+
+
 def test_train_transformer_fed_end_to_end(tmp_path):
     from heterofl_tpu.entry import train_transformer_fed
 

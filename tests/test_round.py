@@ -200,6 +200,26 @@ def test_sbn_and_eval():
     assert res["n"].shape == (4,) and np.all(res["n"] == 25.0)
 
 
+def test_eval_rng_varies_across_epochs():
+    """Eval-time LM token corruption draws fresh noise per round: keys are
+    fold_in(key, epoch), so a frozen model yields *different* Global metrics
+    across epochs (ref draws fresh Bernoulli noise per eval pass,
+    src/models/transformer.py:148-151) while the same epoch reproduces
+    exactly."""
+    cfg, _ = _lm_setup()
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    ev = Evaluator(model, cfg, make_mesh(2, 1))
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 50, size=(2, 2, 48)).astype(np.int64)
+    w = np.ones(rows.shape, np.float32)
+    g0a = ev.eval_global(params, {}, rows, w, epoch=0)
+    g0b = ev.eval_global(params, {}, rows, w, epoch=0)
+    g1 = ev.eval_global(params, {}, rows, w, epoch=1)
+    assert g0a["loss_sum"] == g0b["loss_sum"]
+    assert g0a["loss_sum"] != g1["loss_sum"]
+
+
 def test_client_failure_injection():
     """Failed clients' updates never reach aggregation; an all-failed round
     leaves the global model untouched (stale rule)."""
@@ -358,4 +378,35 @@ def test_scan_unroll_equivalent():
         # fusion reassociation compounds over the local steps; a semantic bug
         # (skipped/duplicated step) would show as O(1e-1) differences
         np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=2e-2, atol=2e-4,
+                                   err_msg=k)
+
+
+def test_scan_unroll_single_step_exact():
+    """With exactly ONE local step (E*S=1) the unrolled and non-unrolled
+    programs must agree near-exactly -- a tight complement to the loose
+    multi-step tolerance above that would catch an off-by-one in the unroll
+    remainder handling (advisor finding, round 2)."""
+    cfg, ds, _ = _vision_setup()
+    cfg["num_epochs"]["local"] = 1
+    model = make_model(cfg)
+    rng = np.random.default_rng(0)
+    # one batch per client: shard size == train batch size -> S=1
+    b = cfg["batch_size"]["train"]
+    x = jnp.asarray(rng.integers(0, 255, (8, b, 28, 28, 1)), jnp.uint8)
+    y = jnp.asarray(rng.integers(0, 10, (8, b)))
+    m = jnp.ones((8, b), jnp.float32)
+    lm = jnp.ones((8, 10), jnp.float32)
+    data = (x, y, m, lm)
+    outs = []
+    for unroll in (1, 3):
+        cfg_u = dict(cfg)
+        cfg_u["scan_unroll"] = unroll
+        p = model.init(jax.random.key(0))
+        eng = RoundEngine(model, cfg_u, make_mesh(1, 1))
+        out, ms = eng.train_round(p, jax.random.key(3), 0.05,
+                                  np.arange(2, dtype=np.int32), data)
+        assert float(np.asarray(ms["n"]).sum()) == 2.0 * b  # exactly one pass
+        outs.append({k: np.asarray(v) for k, v in out.items()})
+    for k in outs[0]:
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=1e-6, atol=1e-7,
                                    err_msg=k)
