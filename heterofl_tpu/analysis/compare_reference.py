@@ -296,7 +296,7 @@ def run_mine_lm(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> Li
     params = model.init(jax.random.key(seed))
     mesh = make_mesh(min(len(jax.devices()), users), 1)
     eng = RoundEngine(model, cfg, mesh)
-    ev = Evaluator(model, cfg, mesh)
+    ev = Evaluator(model, cfg, mesh, seed=seed)
     xs, ws = stack_windows(bptt_windows(np.asarray(ds["test"].token), cfg["bptt"]),
                            cfg["bptt"])
     rng = np.random.default_rng(seed + 77)
@@ -330,7 +330,7 @@ def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[
     params = model.init(jax.random.key(seed))
     mesh = make_mesh(min(len(jax.devices()), users), 1)
     eng = RoundEngine(model, cfg, mesh)
-    ev = Evaluator(model, cfg, mesh)
+    ev = Evaluator(model, cfg, mesh, seed=seed)
     xb, wb = _batch_array(ds["train"].data, 100)
     xg, wg = _batch_array(ds["test"].data, 100)
     yg, _ = _batch_array(ds["test"].target, 100)

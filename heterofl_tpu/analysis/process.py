@@ -62,10 +62,17 @@ def parse_tag(tag: str) -> Optional[Dict[str, str]]:
     mid = head[1:-1]  # data name parts + optional subset
     if not mid:
         return None
-    # subset is a single token when present; prefer interpreting the last mid
-    # token as subset only when the remaining prefix is a known dataset name
+    # subset is a single token when present.  Longest registry match wins
+    # (advisor r3): a full multi-token name that IS registered never loses its
+    # tail to a spurious "subset"; only then is a registered prefix + exactly
+    # one leftover token read as data_name + subset.  Unregistered names that
+    # merely EXTEND a registered one (e.g. a custom "ImageFolder_Pets" with no
+    # subset) remain ambiguous by construction and parse as prefix + subset --
+    # avoid underscores in custom dataset names.
     DATASET_NAMES = C.VISION_DATASETS + C.FOLDER_DATASETS + C.LM_DATASETS
-    if len(mid) >= 2 and "_".join(mid[:-1]) in DATASET_NAMES:
+    if "_".join(mid) in DATASET_NAMES:
+        data_name, subset = "_".join(mid), ""
+    elif len(mid) >= 2 and "_".join(mid[:-1]) in DATASET_NAMES:
         data_name, subset = "_".join(mid[:-1]), mid[-1]
     else:
         # unknown dataset: keep the multi-token name intact rather than

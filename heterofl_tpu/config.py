@@ -84,6 +84,10 @@ DEFAULT_CFG: Dict[str, Any] = {
     "world_size": 1,
     "resume_mode": 0,
     "save_format": "pdf",
+    # ref writes TB scalars + info text every round unconditionally
+    # (src/logger.py:57-84); here the writer is gated so headless runs stay
+    # dependency-light, ON matching the reference when tensorboard is present
+    "use_tensorboard": False,
     # TPU-native extras (no reference counterpart):
     "strategy": "masked",  # "masked" (one program, channel masks) | "sliced"
     # "sharded": per-user train stacks live sharded over the clients axis and

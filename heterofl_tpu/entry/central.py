@@ -136,7 +136,7 @@ class CentralExperiment:
         self.model = make_model(cfg)
         self.mesh = make_mesh(len(jax.devices()), 1)
         self.engine = CentralEngine(self.model, cfg, self.mesh)
-        self.evaluator = Evaluator(self.model, cfg, self.mesh)
+        self.evaluator = Evaluator(self.model, cfg, self.mesh, seed=seed)
         self.scheduler = make_scheduler(cfg)
 
     def _epoch_batches(self):
@@ -173,7 +173,8 @@ class CentralExperiment:
         opt = self.engine.init_opt(params)
         last_epoch = 1
         pivot = -float("inf") if pivot_mode == "max" else float("inf")
-        logger = Logger(os.path.join(cfg["output_dir"], "runs", f"train_{self.tag}"))
+        logger = Logger(os.path.join(cfg["output_dir"], "runs", f"train_{self.tag}"),
+                        use_tensorboard=bool(cfg.get("use_tensorboard")))
         blob = resume(cfg["output_dir"], self.tag, cfg["resume_mode"])
         if blob and "params" in blob:
             params = {k: jnp.asarray(v) for k, v in blob["params"].items()}

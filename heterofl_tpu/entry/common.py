@@ -148,7 +148,7 @@ class FedExperiment:
         except (ValueError, AssertionError):
             self.mesh = make_mesh(len(jax.devices()), 1)
         self.engine = RoundEngine(self.model, cfg, self.mesh)
-        self.evaluator = Evaluator(self.model, cfg, self.mesh)
+        self.evaluator = Evaluator(self.model, cfg, self.mesh, seed=seed)
         self.scheduler = make_scheduler(cfg)
         self.num_active = int(np.ceil(cfg["frac"] * cfg["num_users"]))
         self._round_times: List[float] = []  # steady-state round durations (ETA)
@@ -310,7 +310,8 @@ class FedExperiment:
         self.stage(data_split, label_split)
         params = self.model.init(jax.random.fold_in(self.host_key, 0))
         last_epoch = 1
-        logger = Logger(os.path.join(cfg["output_dir"], "runs", f"train_{self.tag}"))
+        logger = Logger(os.path.join(cfg["output_dir"], "runs", f"train_{self.tag}"),
+                        use_tensorboard=bool(cfg.get("use_tensorboard")))
         pivot = -float("inf") if pivot_mode == "max" else float("inf")
         if blob:
             params = {k: jnp.asarray(v) for k, v in blob["params"].items()}

@@ -33,7 +33,8 @@ def evaluate_experiment(cfg: Dict[str, Any], seed: int, load_tag: str = "best") 
     params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
     data_split, label_split = blob["data_split"], blob["label_split"]
     exp.stage(data_split, label_split)
-    logger = Logger(os.path.join(cfg["output_dir"], "runs", f"test_{exp.tag}"))
+    logger = Logger(os.path.join(cfg["output_dir"], "runs", f"test_{exp.tag}"),
+                    use_tensorboard=bool(cfg.get("use_tensorboard")))
     logger.safe(True)
     # checkpoints store the *resume* epoch (epoch+1); the eval RNG must reuse
     # the epoch the checkpoint was evaluated at during training, or the

@@ -30,9 +30,13 @@ RESNET_BLOCKS = {
     "resnet152": ([3, 8, 36, 3], True),
 }
 
-# the canonical registry lives in config (jax-free for analysis tooling);
-# keep it in lockstep with the families actually buildable here
-assert MODEL_NAMES == ("conv",) + tuple(RESNET_BLOCKS) + ("transformer",)
+# the canonical registry lives in config (jax-free for analysis tooling); keep
+# it in lockstep with the families actually buildable here.  A hard raise, not
+# an assert: the guard must survive `python -O` (advisor r3).
+if MODEL_NAMES != ("conv",) + tuple(RESNET_BLOCKS) + ("transformer",):
+    raise ImportError(
+        f"config.MODEL_NAMES {MODEL_NAMES!r} out of lockstep with buildable "
+        f"families {('conv',) + tuple(RESNET_BLOCKS) + ('transformer',)!r}")
 
 
 def parse_compute_dtype(cd):

@@ -27,10 +27,17 @@ from .round_engine import _ceil_div, _shard_map
 
 
 class Evaluator:
-    def __init__(self, model: ModelDef, cfg: Dict[str, Any], mesh):
+    def __init__(self, model: ModelDef, cfg: Dict[str, Any], mesh, seed: int = 0):
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
+        # Eval RNG descends from the EXPERIMENT seed (ref draws fresh noise
+        # per pass from the global torch RNG, src/models/transformer.py:148-151,
+        # which the experiment seed controls); stream tags 0/1 keep the
+        # per-user and global eval streams distinct.
+        base = jax.random.key(seed)
+        self._users_key = jax.random.fold_in(base, 0)
+        self._global_key = jax.random.fold_in(base, 1)
         self.is_lm = model.meta.get("kind") == "transformer"
         self.norm_stats = cfg.get("norm_stats") or DATASET_STATS.get(cfg["data_name"])
         self.bptt = cfg.get("bptt", 64)
@@ -165,7 +172,7 @@ class Evaluator:
             y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
             m = np.concatenate([m, np.zeros((pad,) + m.shape[1:], np.float32)])
             lm = np.concatenate([lm, np.zeros((pad,) + lm.shape[1:], np.float32)])
-        key = jax.random.fold_in(jax.random.key(0), epoch)
+        key = jax.random.fold_in(self._users_key, epoch)
         out = self._users(params, bn_state, key, jnp.asarray(valid),
                           jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
         return {k: np.asarray(v)[:u] for k, v in out.items()}
@@ -220,6 +227,6 @@ class Evaluator:
             if pad:
                 arr = np.concatenate([arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
             padded.append(jnp.asarray(arr))
-        key = jax.random.fold_in(jax.random.key(1), epoch)
+        key = jax.random.fold_in(self._global_key, epoch)
         out = self._global(params, bn_state, key, *padded)
         return {k: float(v) for k, v in out.items()}

@@ -15,7 +15,10 @@ ENV() {
   env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE -u AXON_LOOPBACK_RELAY \
     JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR=/tmp/jaxcache PYTHONPATH=/root/repo "$@"
 }
-EXTRA="--output_dir $OUT --synthetic_sizes {\"train\":1000,\"test\":500} --override '$OVERRIDE'"
+# JSON kept single-quoted INSIDE the value: the generated grid scripts re-eval
+# this string, and unquoted {...} would hit bash brace expansion and split into
+# two words, failing argparse (advisor r3, medium).
+EXTRA="--output_dir $OUT --synthetic_sizes '{\"train\":1000,\"test\":500}' --override '$OVERRIDE'"
 
 # 1. grids (one job per line, wait barriers -> sequential on this box)
 ENV python -m heterofl_tpu.analysis.make --run train --model conv --fed 1 \

@@ -29,11 +29,21 @@ def test_train_classifier_fed_end_to_end(tmp_path):
     from heterofl_tpu.entry import train_classifier_fed, test_classifier_fed
 
     argv = ["--control_name", "1_8_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1",
-            "--data_name", "MNIST", "--model_name", "conv"] + _override(tmp_path)
+            "--data_name", "MNIST", "--model_name", "conv"] + _override(
+                tmp_path, {"use_tensorboard": True})
     res = train_classifier_fed.main(argv)
     assert len(res) == 1
     hist = res[0]["logger"].history
     assert len(hist["test/Global-Accuracy"]) == 2
+    # TB channel exercised through a real round (ref logger.py:57-84 writes
+    # scalars+text every round); event files land beside the jsonl log
+    run_dir = tmp_path / "runs" / "train_0_MNIST_label_conv_1_8_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1"
+    try:
+        import torch.utils.tensorboard  # noqa: F401
+        assert any(f.startswith("events.out.tfevents")
+                   for f in os.listdir(run_dir)), os.listdir(run_dir)
+    except ImportError:
+        pass
     tag = "0_MNIST_label_conv_1_8_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1"
     ck = tmp_path / "model" / f"{tag}_checkpoint.pkl"
     best = tmp_path / "model" / f"{tag}_best.pkl"

@@ -220,6 +220,24 @@ def test_eval_rng_varies_across_epochs():
     assert g0a["loss_sum"] != g1["loss_sum"]
 
 
+def test_eval_rng_varies_across_seeds():
+    """Eval RNG descends from the EXPERIMENT seed (ref: the eval pass draws
+    from the seed-controlled global torch RNG, src/models/transformer.py:148-151):
+    two experiments with different seeds see different LM corruption noise on
+    the same frozen model, while the same seed reproduces exactly."""
+    cfg, _ = _lm_setup()
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 50, size=(2, 2, 48)).astype(np.int64)
+    w = np.ones(rows.shape, np.float32)
+    g_s0 = Evaluator(model, cfg, make_mesh(2, 1), seed=0).eval_global(params, {}, rows, w, epoch=0)
+    g_s0b = Evaluator(model, cfg, make_mesh(2, 1), seed=0).eval_global(params, {}, rows, w, epoch=0)
+    g_s1 = Evaluator(model, cfg, make_mesh(2, 1), seed=1).eval_global(params, {}, rows, w, epoch=0)
+    assert g_s0["loss_sum"] == g_s0b["loss_sum"]
+    assert g_s0["loss_sum"] != g_s1["loss_sum"]
+
+
 def test_client_failure_injection():
     """Failed clients' updates never reach aggregation; an all-failed round
     leaves the global model untouched (stale rule)."""

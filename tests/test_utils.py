@@ -60,6 +60,35 @@ def test_logger_weighted_mean_and_history(tmp_path):
     assert os.path.exists(tmp_path / "run" / "log.jsonl")
 
 
+def test_logger_tensorboard_scalar_and_text(tmp_path):
+    """TB channel parity (ref ``src/logger.py:57-84``): with
+    ``use_tensorboard=True`` one ``write()`` lands a scalar per metric AND the
+    info line on the text channel, verifiable from the event files on disk."""
+    import pytest
+
+    pytest.importorskip("torch.utils.tensorboard")
+    ea_mod = pytest.importorskip(
+        "tensorboard.backend.event_processing.event_accumulator")
+    lg = Logger(str(tmp_path / "run"), use_tensorboard=True)
+    lg.safe(True)
+    assert lg.writer is not None, "SummaryWriter did not open"
+    lg.append({"Loss": 2.0, "Accuracy": 50.0}, "train", n=10)
+    lg.append({"info": ["Model: x", "Epoch: 1"]}, "train", mean=False)
+    lg.write("train", ["Loss", "Accuracy"])
+    lg.flush()
+    lg.safe(False)
+    acc = ea_mod.EventAccumulator(str(tmp_path / "run"),
+                                  size_guidance={"scalars": 0, "tensors": 0})
+    acc.Reload()
+    tags = acc.Tags()
+    assert "train/Loss" in tags["scalars"]
+    assert "train/Accuracy" in tags["scalars"]
+    assert len(acc.Scalars("train/Loss")) == 1
+    assert abs(acc.Scalars("train/Loss")[0].value - 2.0) < 1e-6
+    # add_text lands on the tensors channel as <tag>/text_summary
+    assert any(t.startswith("train/info") for t in tags["tensors"]), tags["tensors"]
+
+
 def test_checkpoint_roundtrip_and_modes(tmp_path):
     out = str(tmp_path)
     blob = {
