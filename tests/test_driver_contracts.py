@@ -1,12 +1,17 @@
 """The two driver-facing contracts: bench.py's single JSON line and
 __graft_entry__'s compile/dry-run hooks."""
 
+import pytest
+
 import json
 import os
 import subprocess
 import sys
 
 import numpy as np
+
+# runs bench.py / dryrun children with multi-minute timeouts (fast gate excludes this module)
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -7,6 +7,9 @@ import pickle
 import numpy as np
 import pytest
 
+# end-to-end CLI experiments, several jit compiles each (fast gate excludes this module)
+pytestmark = pytest.mark.slow
+
 
 def _override(tmp, extra=None):
     ov = {

@@ -22,6 +22,9 @@ from heterofl_tpu.models.spec import mask_params
 
 from test_models import small_cfg, vision_batch
 
+# compiles a sliced sub-model per rate per family (fast gate excludes this module)
+pytestmark = pytest.mark.slow
+
 
 def _grads(model, params, batch, **kw):
     def loss_fn(p):

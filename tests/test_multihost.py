@@ -18,6 +18,9 @@ import sys
 
 import pytest
 
+# spawns a JAX distributed subprocess (fast gate excludes this module)
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CHILD = r"""

@@ -8,6 +8,9 @@ import pytest
 from heterofl_tpu.ops.layers import batch_norm
 from heterofl_tpu.ops.pallas_norm import batch_norm_pallas
 
+# pallas interpreter-mode kernels on CPU (fast gate excludes this module)
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.parametrize("shape", [(10, 8, 8, 64), (6, 32), (10, 4, 4, 48)])
 def test_matches_xla_batch_norm(shape):

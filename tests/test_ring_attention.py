@@ -1,5 +1,7 @@
 """Ring attention == dense attention, sequence sharded over 8 devices."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -8,6 +10,9 @@ from jax.sharding import PartitionSpec as P
 from heterofl_tpu.parallel import make_mesh
 from heterofl_tpu.parallel.ring_attention import dense_attention, ring_attention
 from heterofl_tpu.parallel.round_engine import _shard_map
+
+# ppermute ring fwd+bwd compiles (fast gate excludes this module)
+pytestmark = pytest.mark.slow
 
 
 def _run(h, S, d, n_dev, seed=0):

@@ -51,6 +51,7 @@ def test_vision_round_loss_decreases_multidevice():
     assert np.isfinite(np.asarray(params2["block1.conv.w"])).all()
 
 
+@pytest.mark.slow
 def test_tiny_shards_smaller_than_batch():
     """Shards with N < batch size (and N < B/2) must still trace and train:
     the epoch permutation is tiled, dead steps are skipped (review regression)."""
@@ -89,6 +90,7 @@ def test_round_deterministic():
         np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]), rtol=1e-6, err_msg=k)
 
 
+@pytest.mark.slow
 def test_dynamic_mode_round():
     cfg, ds, data = _vision_setup(control="1_8_0.5_iid_dynamic_a1-e1_bn_1_1")
     model = make_model(cfg)
@@ -101,6 +103,7 @@ def test_dynamic_mode_round():
     assert np.isfinite(float(np.asarray(ms["loss_sum"]).sum()))
 
 
+@pytest.mark.slow
 def test_lm_round():
     cfg = small_cfg("transformer", data_name="WikiText2")
     users = 4
@@ -129,6 +132,7 @@ def _lm_setup(control="1_4_0.5_iid_fix_a1-b1_bn_1_1", users=4):
     return cfg, (jnp.asarray(rows), jnp.asarray(lm))
 
 
+@pytest.mark.slow
 def test_lm_seq_parallel_matches_single_device():
     """Sequence parallelism over the 'data' axis (ring attention + psum'd
     grads, shard-invariant token corruption) matches the clients-only mesh:
@@ -154,6 +158,7 @@ def test_lm_seq_parallel_matches_single_device():
     np.testing.assert_allclose(np.asarray(ms1["n"]), np.asarray(ms2["n"]))
 
 
+@pytest.mark.slow
 def test_lm_seq_parallel_four_way_with_dropout_runs():
     """4-way sequence sharding with dropout>0 trains and the loss falls."""
     cfg, data = _lm_setup()
@@ -170,6 +175,7 @@ def test_lm_seq_parallel_four_way_with_dropout_runs():
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_sbn_and_eval():
     cfg, ds, data = _vision_setup()
     model = make_model(cfg)
@@ -200,6 +206,7 @@ def test_sbn_and_eval():
     assert res["n"].shape == (4,) and np.all(res["n"] == 25.0)
 
 
+@pytest.mark.slow
 def test_eval_rng_varies_across_epochs():
     """Eval-time LM token corruption draws fresh noise per round: keys are
     fold_in(key, epoch), so a frozen model yields *different* Global metrics
@@ -220,6 +227,7 @@ def test_eval_rng_varies_across_epochs():
     assert g0a["loss_sum"] != g1["loss_sum"]
 
 
+@pytest.mark.slow
 def test_eval_rng_varies_across_seeds():
     """Eval RNG descends from the EXPERIMENT seed (ref: the eval pass draws
     from the seed-controlled global torch RNG, src/models/transformer.py:148-151):
@@ -238,6 +246,7 @@ def test_eval_rng_varies_across_seeds():
     assert g_s0["loss_sum"] != g_s1["loss_sum"]
 
 
+@pytest.mark.slow
 def test_client_failure_injection():
     """Failed clients' updates never reach aggregation; an all-failed round
     leaves the global model untouched (stale rule)."""
@@ -262,6 +271,7 @@ def test_client_failure_injection():
     assert 0 < (n2 > 0).sum() < 8  # some failed, some trained
 
 
+@pytest.mark.slow
 def test_data_parallel_axis_matches_single_device():
     """Intra-client batch DP over the 'data' axis (psum'd grads + sync BN) is
     numerically identical to running each client on one device: a (2,2) mesh
@@ -286,6 +296,7 @@ def test_data_parallel_axis_matches_single_device():
     np.testing.assert_allclose(np.asarray(ms1["n"]), np.asarray(ms2["n"]))
 
 
+@pytest.mark.slow
 def test_sharded_placement_matches_replicated():
     """Client-sharded data placement (each client trains on the device owning
     its shard, VERDICT r1 item 6): numerically identical global params to the
@@ -324,6 +335,7 @@ def test_sharded_placement_matches_replicated():
     assert np.asarray(ms1["n"]).sum() == np.asarray(ms2["n"]).sum()
 
 
+@pytest.mark.slow
 def test_sharded_placement_lm_matches_replicated():
     """Sharded placement on the LM path: token-row stacks sharded over the
     clients axis give the same round as replicated."""
@@ -352,6 +364,7 @@ def test_sharded_placement_lm_matches_replicated():
     np.testing.assert_allclose(np.asarray(ms1["n"]).sum(), np.asarray(ms2["n"]).sum())
 
 
+@pytest.mark.slow
 def test_sharded_placement_unbalanced_and_padded():
     """Sharded placement with a non-divisible user count and an unbalanced
     active set (3 actives owned by one device) trains correctly; padded users
@@ -378,6 +391,7 @@ def test_sharded_placement_unbalanced_and_padded():
         assert np.isfinite(np.asarray(out[k])).all(), k
 
 
+@pytest.mark.slow
 def test_scan_unroll_equivalent():
     """``scan_unroll`` is a pure perf knob: unrolled local-step loops (incl. a
     non-dividing factor) give the same round up to XLA fusion reassociation."""
@@ -399,6 +413,7 @@ def test_scan_unroll_equivalent():
                                    err_msg=k)
 
 
+@pytest.mark.slow
 def test_scan_unroll_single_step_exact():
     """With exactly ONE local step (E*S=1) the unrolled and non-unrolled
     programs must agree near-exactly -- a tight complement to the loose

@@ -2,6 +2,8 @@
 sub-models) and the masked engine (full-width + channel masks) produce the
 SAME new global parameters from the same inputs and PRNG keys."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -11,6 +13,9 @@ from heterofl_tpu.models import make_model
 from heterofl_tpu.parallel import RoundEngine, make_mesh
 
 from test_round import _vision_setup
+
+# compiles five per-level programs plus the masked engine (fast gate excludes this module)
+pytestmark = pytest.mark.slow
 
 
 def test_sliced_round_matches_masked_round():

@@ -27,6 +27,9 @@ from heterofl_tpu import config as C  # noqa: E402
 from heterofl_tpu.fed import extract_sliced  # noqa: E402
 from heterofl_tpu.models import make_model  # noqa: E402
 
+# loads the torch reference per test (fast gate excludes this module)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def ref_modules():
