@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Merge the round-3/4 convergence-campaign artifacts (/tmp/PARITY_R3_*)
+into repo PARITY_R3_*.json files and print the mean±std curve summary that
+PARITY.md quotes (VERDICT r3 item 2).
+
+Each campaign ran one side at a time (--skip): REF files carry
+``reference_acc``, MINE files carry ``mine_acc``.  The merged repo artifact
+holds both full 100-round curves plus final-gap and curve-distance stats.
+"""
+
+import json
+import os
+
+import numpy as np
+
+CAMPAIGNS = [
+    # (name, ref /tmp stem, mine /tmp stem, seeds)
+    ("MNIST_NONIID", "PARITY_R3_REF_MNIST_NONIID_S{s}", "PARITY_R3_MINE_MNIST_NONIID_S{s}", (0, 1, 2)),
+    ("DYNAMIC", "PARITY_R3_REF_DYNAMIC_S{s}", "PARITY_R3_MINE_DYNAMIC_S{s}", (0,)),
+    ("INTERP_A1B9", "PARITY_R3_REF_INTERP_A1B9_S{s}", "PARITY_R3_MINE_INTERP_A1B9_S{s}", (0,)),
+    ("INTERP_A5E5", "PARITY_R3_REF_INTERP_A5E5_S{s}", "PARITY_R3_MINE_INTERP_A5E5_S{s}", (0,)),
+    ("CIFAR", "PARITY_R3_REF_CIFAR_S{s}", "PARITY_R3_MINE_CIFAR_S{s}", (0, 1, 2)),
+]
+
+
+def main():
+    os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    summary = {}
+    for name, ref_t, mine_t, seeds in CAMPAIGNS:
+        finals_ref, finals_mine, gaps = [], [], []
+        for s in seeds:
+            ref_p = f"/tmp/{ref_t.format(s=s)}.json"
+            mine_p = f"/tmp/{mine_t.format(s=s)}.json"
+            if not (os.path.exists(ref_p) and os.path.exists(mine_p)):
+                print(f"skip {name} S{s}: missing "
+                      f"{[p for p in (ref_p, mine_p) if not os.path.exists(p)]}")
+                continue
+            with open(ref_p) as f:
+                ref = json.load(f)["reference_acc"]
+            with open(mine_p) as f:
+                mine = json.load(f)["mine_acc"]
+            if not ref or not mine:
+                print(f"skip {name} S{s}: empty curve")
+                continue
+            n = min(len(ref), len(mine))
+            ref, mine = ref[:n], mine[:n]
+            curve_gap = [m - r for m, r in zip(mine, ref)]
+            rep = {"reference_acc": ref, "mine_acc": mine,
+                   "final_gap_pp": round(curve_gap[-1], 2),
+                   "mean_abs_curve_gap_pp": round(float(np.mean(np.abs(curve_gap))), 2),
+                   "rounds": n}
+            out_p = f"PARITY_R3_{name}_S{s}.json"
+            with open(out_p, "w") as f:
+                json.dump(rep, f)
+            print(f"{out_p}: ref_final={ref[-1]:.2f} mine_final={mine[-1]:.2f} "
+                  f"gap={rep['final_gap_pp']:+.2f}pp mean|gap|={rep['mean_abs_curve_gap_pp']:.2f}pp")
+            finals_ref.append(ref[-1])
+            finals_mine.append(mine[-1])
+            gaps.append(curve_gap)
+        if finals_ref:
+            g = np.array(gaps)
+            summary[name] = {
+                "seeds": len(finals_ref),
+                "ref_final": f"{np.mean(finals_ref):.2f}±{np.std(finals_ref):.2f}",
+                "mine_final": f"{np.mean(finals_mine):.2f}±{np.std(finals_mine):.2f}",
+                "final_gap_pp": f"{np.mean(g[:, -1]):+.2f}",
+                "mean_abs_curve_gap_pp": f"{np.mean(np.abs(g)):.2f}",
+            }
+    print(json.dumps(summary, indent=1))
+    # decile curve table for PARITY.md (mean across seeds at rounds 10..100)
+    for name, ref_t, mine_t, seeds in CAMPAIGNS:
+        rows_r, rows_m = [], []
+        for s in seeds:
+            out_p = f"PARITY_R3_{name}_S{s}.json"
+            if not os.path.exists(out_p):
+                continue
+            with open(out_p) as f:
+                d = json.load(f)
+            rows_r.append(d["reference_acc"])
+            rows_m.append(d["mine_acc"])
+        if not rows_r:
+            continue
+        n = min(len(r) for r in rows_r + rows_m)
+        rr = np.mean([r[:n] for r in rows_r], axis=0)
+        mm = np.mean([m[:n] for m in rows_m], axis=0)
+        idx = [i for i in range(max(0, n // 10 - 1), n, max(1, n // 10))]
+        print(f"curve {name} rounds:    " + " ".join(f"{i+1:6d}" for i in idx))
+        print(f"curve {name} ref mean:  " + " ".join(f"{rr[i]:6.2f}" for i in idx))
+        print(f"curve {name} mine mean: " + " ".join(f"{mm[i]:6.2f}" for i in idx))
+
+
+if __name__ == "__main__":
+    main()

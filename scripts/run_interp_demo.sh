@@ -10,7 +10,7 @@ set -eu
 cd /root/repo
 OUT=${1:-output_interp}
 MODES="a1,b1,a1-b9,a3-b7,a5-b5,a7-b3,a9-b1"
-OVERRIDE='{"num_epochs": {"global": 10, "local": 1}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
+OVERRIDE='{"num_epochs": {"global": 30, "local": 2}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
 ENV() {
   env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE -u AXON_LOOPBACK_RELAY \
     JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR=/tmp/jaxcache PYTHONPATH=/root/repo "$@"
@@ -18,7 +18,7 @@ ENV() {
 # JSON kept single-quoted INSIDE the value: the generated grid scripts re-eval
 # this string, and unquoted {...} would hit bash brace expansion and split into
 # two words, failing argparse (advisor r3, medium).
-EXTRA="--output_dir $OUT --synthetic_sizes '{\"train\":1000,\"test\":500}' --override '$OVERRIDE'"
+EXTRA="--output_dir $OUT --synthetic_sizes '{\"train\":4000,\"test\":1000}' --override '$OVERRIDE'"
 
 # 1. grids (one job per line, wait barriers -> sequential on this box)
 ENV python -m heterofl_tpu.analysis.make --run train --model conv --fed 1 \

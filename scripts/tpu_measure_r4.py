@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Round-4 TPU measurement session (VERDICT r3 item 4): one tunnel claim,
+three measurements, one JSON line each (flushed immediately so a wedge keeps
+the partials):
+
+1. flagship-bench rehearsal  -- the BASELINE.json config (100-client CIFAR10
+   ResNet-18 a1-e1, bf16) timed for rounds/sec; also warms the repo compile
+   cache the driver's bench.py will hit.
+2. MFU accounting            -- compiled-program FLOPs (XLA cost_analysis) /
+   measured round time vs the chip's peak; answers "how far from the MXU
+   ceiling is the 20 ms step".
+3. client-fold A/B           -- the same local-SGD scan with (a) 10 vmapped
+   clients x batch 10 (the engine's form: per-client weights => grouped
+   convs), (b) one shared-weight batch-100 program (the fold), (c) one
+   shared-weight batch-10 program (the per-chip pod proxy).  (b)~(a) means
+   steps are latency-bound and the fold buys nothing; (b)<<(a) means the
+   batched-kernel lowering is the bottleneck and a block-diagonal/bmm conv
+   path is the next optimization.
+
+Peak FLOP/s table keyed by device_kind prefix; defaults to v5e bf16.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PEAK_BF16 = {
+    "TPU v5e": 197e12, "TPU v5 lite": 197e12, "TPU v4": 275e12,
+    "TPU v5p": 459e12, "TPU v6e": 918e12,
+}
+
+
+def emit(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache", "measure"))
+    import jax
+    import jax.numpy as jnp
+
+    from heterofl_tpu import config as C
+    from heterofl_tpu.data import (fetch_dataset, label_split_masks, split_dataset,
+                                   stack_client_shards)
+    from heterofl_tpu.models import make_model
+    from heterofl_tpu.parallel import RoundEngine, make_mesh
+
+    t_claim = time.time()
+    devs = jax.devices()
+    kind = devs[0].device_kind
+    emit({"measure": "platform", "platform": devs[0].platform,
+          "device_kind": kind, "claim_sec": round(time.time() - t_claim, 1)})
+    peak = next((v for k, v in PEAK_BF16.items() if kind.startswith(k)), 197e12)
+
+    smoke = os.environ.get("MEAS_SMOKE") == "1"  # CPU logic check only
+    users, timed = (20, 1) if smoke else (100, 5)
+    n_synth = 2000 if smoke else 50000
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    cfg["data_name"], cfg["model_name"], cfg["synthetic"] = "CIFAR10", "resnet18", True
+    cfg["compute_dtype"] = "bfloat16"
+    cfg = C.process_control(cfg)
+    cfg["classes_size"] = 10
+
+    if smoke:
+        cfg["resnet"] = {"hidden_size": [8, 16, 16, 16]}
+    ds = fetch_dataset("CIFAR10", synthetic=True, seed=0,
+                       synthetic_sizes={"train": n_synth, "test": 1000})
+    rng = np.random.default_rng(0)
+    split, lsplit = split_dataset(ds, users, "iid", rng)
+    x, y, m = stack_client_shards(ds["train"].data, ds["train"].target,
+                                  split["train"], list(range(users)))
+    lm = label_split_masks(lsplit, users, 10)
+    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    mesh = make_mesh(len(devs), 1)
+    eng = RoundEngine(model, cfg, mesh)
+    srng = np.random.default_rng(1)
+
+    def once(p, r):
+        uidx = srng.permutation(users)[:10].astype(np.int32)
+        return eng.train_round(p, jax.random.key(r), 0.1, uidx, data)
+
+    # ---- 1. flagship rehearsal -------------------------------------------
+    t0 = time.time()
+    params, _ = once(params, 0)
+    jax.block_until_ready(params)
+    compile_s = time.time() - t0
+    emit({"measure": "flagship_compile", "compile_sec": round(compile_s, 1)})
+    t0 = time.time()
+    for r in range(1, timed + 1):
+        params, ms = once(params, r)
+        jax.block_until_ready(params)
+        dt = (time.time() - t0) / r
+        emit({"measure": "flagship_round", "r": r, "avg_round_sec": round(dt, 3),
+              "rounds_per_sec": round(1.0 / dt, 4)})
+
+    # ---- 2. MFU from compiled-program FLOPs ------------------------------
+    # Re-lower the already-jitted round program with the concrete args the
+    # engine passes (replicated placement) and read XLA's flop count.
+    try:
+        user_idx = srng.permutation(users)[:10].astype(np.int32)
+        a = len(user_idx)
+        pad = (-a) % mesh.shape["clients"]
+        uglob = np.concatenate([user_idx, -np.ones(pad, np.int32)]).astype(np.int32)
+        args = (params, jax.random.key(99), jnp.asarray(0.1, jnp.float32),
+                jnp.asarray(uglob), jnp.asarray(uglob)) + tuple(data) + (eng.fix_rates,)
+        lowered = eng._train.lower(*args)
+        cost = lowered.compile().cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops = float(cost.get("flops", float("nan")))
+        mfu = flops / dt / peak
+        emit({"measure": "mfu", "program_flops": flops,
+              "round_sec": round(dt, 3), "peak_flops_per_sec": peak,
+              "mfu": round(mfu, 4),
+              "note": "program_flops is XLA's static count for ONE round "
+                      "(250 local steps x 10 clients x batch 10, masked "
+                      "full-width)"})
+    except Exception as e:  # cost_analysis availability varies by backend
+        emit({"measure": "mfu", "error": repr(e)[:300]})
+
+    # ---- 3. client-fold A/B ----------------------------------------------
+    # One local-epoch scan (250 steps) stripped to fwd+bwd+SGD, no aggregation:
+    # isolates the step engine from the round program.
+    from heterofl_tpu.ops.augment import normalize_image
+
+    stats = None
+    try:
+        from heterofl_tpu.data.datasets import DATASET_STATS
+        stats = DATASET_STATS.get("CIFAR10")
+    except Exception:
+        pass
+
+    def norm_img(xb):
+        xb = xb.astype(jnp.float32)
+        return normalize_image(xb, *stats) if stats else xb / 255.0
+
+    def loss_fn(p, xb, yb):
+        out, _ = model.apply(p, {"img": norm_img(xb), "label": yb}, train=True)
+        return out["loss"]
+
+    def sgd_scan(p, xs, ys, lr=0.1):
+        def step(p, inp):
+            xb, yb = inp
+            g = jax.grad(loss_fn)(p, xb, yb)
+            return jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g), 0.0
+        p, _ = jax.lax.scan(step, p, (xs, ys))
+        return p
+
+    # 250 steps = 5 local epochs x 50 steps over each client's 500 images,
+    # so the step stream tiles the client shard 5x (mirrors the engine)
+    per = np.asarray(x).shape[1]
+    spe = per // 10                       # steps per epoch at batch 10
+    n_ep = 1 if smoke else 5
+    S = spe * n_ep
+    xc = np.asarray(x)[:10, : spe * 10].reshape(10, spe, 10, 32, 32, 3)
+    yc = np.asarray(y)[:10, : spe * 10].reshape(10, spe, 10)
+    xs10 = jnp.asarray(np.tile(xc, (1, n_ep, 1, 1, 1, 1)))
+    ys10 = jnp.asarray(np.tile(yc, (1, n_ep, 1)))
+
+    def timeit(name, fn, *args):
+        t0 = time.time()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        c = time.time() - t0
+        reps = 3
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        d = (time.time() - t0) / reps
+        emit({"measure": name, "sec": round(d, 3), "ms_per_step": round(d / S * 1e3, 3),
+              "compile_sec": round(c - d, 1)})
+        return d
+
+    # (a) engine form: vmapped clients, per-client weights
+    pv = jax.tree_util.tree_map(lambda a: jnp.broadcast_to(a, (10,) + a.shape), params)
+    fa = jax.jit(jax.vmap(sgd_scan))
+    da = timeit("fold_ab_a_vmap10x10", fa, pv, xs10, ys10)
+    # (b) the fold: shared weights, batch 100
+    xs100 = jnp.asarray(np.asarray(xs10).transpose(1, 0, 2, 3, 4, 5).reshape(S, 100, 32, 32, 3))
+    ys100 = jnp.asarray(np.asarray(ys10).transpose(1, 0, 2).reshape(S, 100))
+    fb = jax.jit(sgd_scan)
+    db = timeit("fold_ab_b_shared_batch100", fb, params, xs100, ys100)
+    # (c) pod per-chip proxy: shared weights, batch 10
+    dc = timeit("fold_ab_c_shared_batch10", fb, params, xs10[0], ys10[0])
+    emit({"measure": "fold_ab_summary",
+          "vmap10x10_ms": round(da / S * 1e3, 3),
+          "shared100_ms": round(db / S * 1e3, 3),
+          "shared10_ms": round(dc / S * 1e3, 3),
+          "verdict": ("latency-bound: fold buys nothing"
+                      if db > 0.8 * da else
+                      "batched-kernel lowering is the bottleneck")})
+
+    # norm=none floor re-check for the attribution table
+    cfg2 = C.default_cfg()
+    cfg2["control"] = C.parse_control_name(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_none_1_1")  # noqa: E501
+    cfg2["data_name"], cfg2["model_name"], cfg2["synthetic"] = "CIFAR10", "resnet18", True
+    cfg2["compute_dtype"] = "bfloat16"
+    cfg2 = C.process_control(cfg2)
+    cfg2["classes_size"] = 10
+    if smoke:
+        cfg2["resnet"] = {"hidden_size": [8, 16, 16, 16]}
+    model2 = make_model(cfg2)
+    p2 = model2.init(jax.random.key(0))
+    eng2 = RoundEngine(model2, cfg2, mesh)
+
+    def once2(p, r):
+        uidx = srng.permutation(users)[:10].astype(np.int32)
+        return eng2.train_round(p, jax.random.key(r), 0.1, uidx, data)
+
+    t0 = time.time()
+    p2, _ = once2(p2, 0)
+    jax.block_until_ready(p2)
+    c2 = time.time() - t0
+    t0 = time.time()
+    for r in range(1, 4):
+        p2, _ = once2(p2, r)
+    jax.block_until_ready(p2)
+    d2 = (time.time() - t0) / 3
+    emit({"measure": "norm_none_round", "round_sec": round(d2, 3),
+          "ms_per_step": round(d2 / 250 * 1e3, 2), "compile_sec": round(c2, 1)})
+    emit({"measure": "DONE"})
+
+
+if __name__ == "__main__":
+    main()

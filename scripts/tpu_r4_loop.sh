@@ -1,0 +1,15 @@
+#!/bin/bash
+# Retry scripts/tpu_r4_session.py until the tunnel clears and the session
+# completes (or attempts run out).  Exit 3 from the session = claim wedged.
+LOG=${1:-/tmp/tpu_r4_session.log}
+cd /root/repo
+for i in $(seq 1 24); do
+  echo "=== r4 session attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
+  timeout 7200 python -u scripts/tpu_r4_session.py >> "$LOG" 2>&1
+  rc=$?
+  echo "=== attempt $i rc=$rc $(date -u +%H:%M:%S) ===" >> "$LOG"
+  if [ "$rc" = "0" ]; then exit 0; fi
+  sleep 240
+done
+echo "=== r4 session gave up $(date -u +%H:%M:%S) ===" >> "$LOG"
+exit 1

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""One TPU tunnel claim, the whole round-4 device program (VERDICT r3 items
+2+4): the mine-side convergence campaigns (100-round curves; each run writes
+its /tmp/PARITY_R3_MINE_*.json on completion, so a mid-session kill keeps all
+finished runs) followed by the measurement session (bench rehearsal, MFU,
+client-fold A/B).
+
+A watchdog aborts with exit code 3 if the tunnel claim itself does not
+complete within TPU_CLAIM_TIMEOUT (default 600 s) -- the retry loop
+(tpu_r4_loop.sh) treats that as "tunnel still wedged, try again later".
+Progress goes to stderr; artifacts to /tmp and stdout JSON lines.
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLAIMED = False
+
+
+def _watchdog():
+    budget = float(os.environ.get("TPU_CLAIM_TIMEOUT", "600"))
+    time.sleep(budget)
+    if not CLAIMED:
+        print(f"tpu_r4_session: claim exceeded {budget:.0f}s, aborting",
+              file=sys.stderr, flush=True)
+        os._exit(3)
+
+
+def main():
+    global CLAIMED
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache", "tpu"))
+    threading.Thread(target=_watchdog, daemon=True).start()
+    t0 = time.time()
+    print("tpu_r4_session: claiming devices...", file=sys.stderr, flush=True)
+    import jax
+
+    devs = jax.devices()
+    CLAIMED = True
+    print(f"tpu_r4_session: claimed {devs[0].device_kind} "
+          f"in {time.time() - t0:.1f}s", file=sys.stderr, flush=True)
+    if devs[0].platform == "cpu":
+        print("tpu_r4_session: got CPU, refusing (this session is for the "
+              "real chip)", file=sys.stderr, flush=True)
+        return 4
+    # the CPU fallback twin of this campaign (run_parity_r3_mine.py) is now
+    # redundant and would fight this session for the single core
+    os.system("pkill -f run_parity_r3_mine 2>/dev/null")
+
+    from heterofl_tpu.analysis import compare_reference as cr
+
+    MNIST = ["--data", "MNIST", "--model", "conv", "--hidden", "64,128,256,512",
+             "--users", "100", "--frac", "0.1", "--rounds", "100",
+             "--local_epochs", "5", "--n_train", "2000", "--n_test", "1000",
+             "--skip", "reference"]
+    CIFAR = ["--data", "CIFAR10", "--model", "resnet18", "--hidden", "64,128",
+             "--users", "100", "--frac", "0.1", "--rounds", "100",
+             "--local_epochs", "1", "--n_train", "2000", "--n_test", "1000",
+             "--skip", "reference"]
+
+    runs = []
+    for s in (0, 1, 2):
+        runs.append((f"MNIST non-iid S{s}",
+                     MNIST + ["--split", "non-iid-2", "--seed", str(s),
+                              "--out", f"/tmp/PARITY_R3_MINE_MNIST_NONIID_S{s}.json"]))
+    runs.append(("MNIST dynamic", MNIST + ["--model_split", "dynamic", "--mode", "a1-e1",
+                                           "--seed", "0", "--out", "/tmp/PARITY_R3_MINE_DYNAMIC_S0.json"]))
+    runs.append(("MNIST interp a1-b9", MNIST + ["--mode", "a1-b9", "--seed", "0",
+                                                "--out", "/tmp/PARITY_R3_MINE_INTERP_A1B9_S0.json"]))
+    runs.append(("MNIST interp a5-e5", MNIST + ["--mode", "a5-e5", "--seed", "0",
+                                                "--out", "/tmp/PARITY_R3_MINE_INTERP_A5E5_S0.json"]))
+    for s in (0, 1, 2):
+        runs.append((f"CIFAR resnet18 S{s}",
+                     CIFAR + ["--seed", str(s),
+                              "--out", f"/tmp/PARITY_R3_MINE_CIFAR_S{s}.json"]))
+
+    for name, args in runs:
+        out = args[args.index("--out") + 1]
+        if os.path.exists(out):
+            print(f"tpu_r4_session: skip {name} (artifact exists)",
+                  file=sys.stderr, flush=True)
+            continue
+        t = time.time()
+        print(f"tpu_r4_session: campaign {name} ...", file=sys.stderr, flush=True)
+        cr.main(args)
+        print(f"tpu_r4_session: campaign {name} done in {time.time() - t:.0f}s",
+              file=sys.stderr, flush=True)
+
+    print("tpu_r4_session: measurements ...", file=sys.stderr, flush=True)
+    import importlib
+
+    meas = importlib.import_module("tpu_measure_r4")
+    meas.main()
+    print("tpu_r4_session: ALL DONE", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.exit(main())

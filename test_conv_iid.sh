@@ -1,15 +1,15 @@
 #!/bin/bash
-python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a1_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":1000,"test":500}' --override '{"num_epochs": {"global": 10, "local": 1}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
+python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a1_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":4000,"test":1000}' --override '{"num_epochs": {"global": 30, "local": 2}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
 wait
-python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_b1_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":1000,"test":500}' --override '{"num_epochs": {"global": 10, "local": 1}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
+python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_b1_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":4000,"test":1000}' --override '{"num_epochs": {"global": 30, "local": 2}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
 wait
-python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a1-b9_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":1000,"test":500}' --override '{"num_epochs": {"global": 10, "local": 1}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
+python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a1-b9_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":4000,"test":1000}' --override '{"num_epochs": {"global": 30, "local": 2}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
 wait
-python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a3-b7_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":1000,"test":500}' --override '{"num_epochs": {"global": 10, "local": 1}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
+python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a3-b7_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":4000,"test":1000}' --override '{"num_epochs": {"global": 30, "local": 2}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
 wait
-python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a5-b5_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":1000,"test":500}' --override '{"num_epochs": {"global": 10, "local": 1}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
+python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a5-b5_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":4000,"test":1000}' --override '{"num_epochs": {"global": 30, "local": 2}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
 wait
-python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a7-b3_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":1000,"test":500}' --override '{"num_epochs": {"global": 10, "local": 1}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
+python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a7-b3_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":4000,"test":1000}' --override '{"num_epochs": {"global": 30, "local": 2}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
 wait
-python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a9-b1_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":1000,"test":500}' --override '{"num_epochs": {"global": 10, "local": 1}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
+python -m heterofl_tpu.entry.test_classifier_fed --data_name MNIST --model_name conv --init_seed 0 --num_experiments 1 --resume_mode 0 --control_name 1_100_0.1_iid_fix_a9-b1_bn_1_1 --synthetic 1 --output_dir output_interp --synthetic_sizes '{"train":4000,"test":1000}' --override '{"num_epochs": {"global": 30, "local": 2}, "conv": {"hidden_size": [16, 32]}, "batch_size": {"train": 10, "test": 50}}'
 wait
