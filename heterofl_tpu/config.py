@@ -96,6 +96,10 @@ DEFAULT_CFG: Dict[str, Any] = {
     "data_placement": "replicated",
     # fuse the train-time masked BN into a Pallas TPU kernel (ops/pallas_norm.py)
     "pallas_norm": False,
+    # conv lowering: None/"direct" = lax.conv (vmapped per-client kernels
+    # become grouped convs); "im2col" = patch-extraction + batched matmul,
+    # which keeps the client-vmapped hot path on dense MXU ops (ops/layers.py)
+    "conv_impl": None,
     # lax.scan unroll factor for the local-step loop (1 = no unrolling);
     # latency-bound rounds can gain from fewer loop trips, A/B in tpu_ab.py
     "scan_unroll": 1,

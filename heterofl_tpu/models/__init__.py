@@ -57,16 +57,23 @@ def make_model(cfg: Dict[str, Any], model_rate: Optional[float] = None) -> Model
     scaler_rate = model_rate / cfg["global_model_rate"]
     compute_dtype = parse_compute_dtype(cfg.get("compute_dtype"))
     pallas_norm = bool(cfg.get("pallas_norm", False))
+    conv_impl = cfg.get("conv_impl")  # None (direct) | "im2col" (bmm path)
+    if conv_impl not in (None, "direct", "im2col"):
+        raise ValueError(f"Not valid conv_impl: {conv_impl!r}")
+    if conv_impl == "direct":
+        conv_impl = None
     if name == "conv":
         model = make_conv(cfg["data_shape"], scaled_hidden(cfg["conv"]["hidden_size"], model_rate),
                           cfg["classes_size"], norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"],
-                          compute_dtype=compute_dtype, pallas_norm=pallas_norm)
+                          compute_dtype=compute_dtype, pallas_norm=pallas_norm,
+                          conv_impl=conv_impl)
     elif name in RESNET_BLOCKS:
         num_blocks, bottleneck = RESNET_BLOCKS[name]
         model = make_resnet(cfg["data_shape"], scaled_hidden(cfg["resnet"]["hidden_size"], model_rate),
                             num_blocks, cfg["classes_size"], bottleneck=bottleneck,
                             norm=cfg["norm"], scale=cfg["scale"], mask=cfg["mask"],
-                            compute_dtype=compute_dtype, pallas_norm=pallas_norm)
+                            compute_dtype=compute_dtype, pallas_norm=pallas_norm,
+                            conv_impl=conv_impl)
     elif name == "transformer":
         t = cfg["transformer"]
         model = make_transformer(

@@ -23,7 +23,7 @@ from .spec import Group, ParamSpec
 
 def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
               scale: bool = True, mask: bool = True, compute_dtype=None,
-              pallas_norm: bool = False) -> ModelDef:
+              pallas_norm: bool = False, conv_impl=None) -> ModelDef:
     """Build the CNN at the given (global) widths.
 
     ``hidden_size`` are the *constructed* widths: the global model passes
@@ -69,7 +69,7 @@ def make_conv(data_shape, hidden_size, classes_size, *, norm: str = "bn",
         collected = {}
         for i in range(n_blocks):
             x = conv2d(x, params[f"block{i}.conv.w"], params[f"block{i}.conv.b"],
-                       compute_dtype=compute_dtype)
+                       compute_dtype=compute_dtype, impl=conv_impl)
             if scale:
                 x = scaler(x, scaler_rate, train)
             g = groups[f"h{i}"]

@@ -30,7 +30,7 @@ from .spec import Group, ParamSpec
 def make_resnet(data_shape, hidden_size, num_blocks: List[int], classes_size: int, *,
                 bottleneck: bool = False, norm: str = "bn", scale: bool = True,
                 mask: bool = True, compute_dtype=None,
-                pallas_norm: bool = False) -> ModelDef:
+                pallas_norm: bool = False, conv_impl=None) -> ModelDef:
     in_ch = data_shape[-1]
     expansion = 4 if bottleneck else 1
     n_stages = len(hidden_size)
@@ -114,7 +114,7 @@ def make_resnet(data_shape, hidden_size, num_blocks: List[int], classes_size: in
         params["linear.b"] = jnp.zeros(classes_size, jnp.float32)
         return params
 
-    conv2d = partial(_conv2d, compute_dtype=compute_dtype)
+    conv2d = partial(_conv2d, compute_dtype=compute_dtype, impl=conv_impl)
     linear = partial(_linear, compute_dtype=compute_dtype)
 
     def apply(params, batch, *, train: bool, width_rate=1.0, scaler_rate=1.0,

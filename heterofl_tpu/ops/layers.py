@@ -25,21 +25,47 @@ from jax import lax
 
 def conv2d(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray] = None,
            stride: int = 1, padding: int = 1,
-           compute_dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+           compute_dtype: Optional[jnp.dtype] = None,
+           impl: Optional[str] = None) -> jnp.ndarray:
     """3x3/1x1 convolution, NHWC x HWIO -> NHWC.
 
     ``compute_dtype`` (e.g. bfloat16) casts the MXU operands while
     accumulating in float32 -- the TPU mixed-precision recipe; params stay
     float32 outside the op.
+
+    ``impl='im2col'`` expresses the op as patch extraction + matmul.  Under
+    ``vmap`` with per-client kernels (the federated round engine's hot path)
+    the direct form lowers to a ``feature_group_count=clients`` grouped
+    convolution whose small per-group channel counts under-tile the 128x128
+    MXU; the im2col form instead keeps patch extraction a *shared-kernel*
+    dense conv (vmap folds clients into the batch dim) and turns only the
+    kernel application into a batched matmul, which the MXU executes
+    natively.  Numerically identical (same f32 accumulation); see
+    tests/test_models.py::test_conv2d_im2col_matches_direct.
     """
     if compute_dtype is not None:
         x, w = x.astype(compute_dtype), w.astype(compute_dtype)
-    y = lax.conv_general_dilated(
-        x, w,
-        window_strides=(stride, stride),
-        padding=((padding, padding), (padding, padding)),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
+    if impl == "im2col":
+        kh, kw, cin, cout = w.shape
+        if (kh, kw) == (1, 1) and padding == 0:
+            # 1x1 conv IS a matmul on strided pixels; skip patch extraction
+            patches = x[:, ::stride, ::stride, :]
+            y = patches @ w.reshape(cin, cout)
+        else:
+            patches = lax.conv_general_dilated_patches(
+                x, filter_shape=(kh, kw), window_strides=(stride, stride),
+                padding=((padding, padding), (padding, padding)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            # patch features are ordered (C, kh, kw); transpose w to match
+            w_flat = jnp.transpose(w, (2, 0, 1, 3)).reshape(kh * kw * cin, cout)
+            y = patches @ w_flat
+    else:
+        y = lax.conv_general_dilated(
+            x, w,
+            window_strides=(stride, stride),
+            padding=((padding, padding), (padding, padding)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
     if compute_dtype is not None:
         y = y.astype(jnp.float32)  # XLA:TPU accumulates bf16 convs in f32
     if b is not None:

@@ -58,7 +58,10 @@ def main():
             finals_mine.append(mine[-1])
             gaps.append(curve_gap)
         if finals_ref:
-            g = np.array(gaps)
+            # seeds can carry different round counts (a killed run truncates
+            # its curve); align to the shortest before stacking
+            n_min = min(len(r) for r in gaps)
+            g = np.array([r[:n_min] for r in gaps])
             summary[name] = {
                 "seeds": len(finals_ref),
                 "ref_final": f"{np.mean(finals_ref):.2f}±{np.std(finals_ref):.2f}",
