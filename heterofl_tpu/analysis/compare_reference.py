@@ -330,7 +330,11 @@ def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[
     params = model.init(jax.random.key(seed))
     mesh = make_mesh(min(len(jax.devices()), users), 1)
     eng = RoundEngine(model, cfg, mesh)
-    ev = Evaluator(model, cfg, mesh, seed=seed)
+    # eval/sBN run UNvmapped (no per-client kernels), where the direct conv
+    # lowering is the faster one; conv_impl only pays off inside the engine
+    cfg_eval = dict(cfg)
+    cfg_eval["conv_impl"] = None
+    ev = Evaluator(make_model(cfg_eval), cfg_eval, mesh, seed=seed)
     xb, wb = _batch_array(ds["train"].data, 100)
     xg, wg = _batch_array(ds["test"].data, 100)
     yg, _ = _batch_array(ds["test"].target, 100)
@@ -380,12 +384,18 @@ def main(argv=None):
                         help="fix: static per-user rates; dynamic: re-rolled "
                              "per round (ref fed.py:15-23)")
     parser.add_argument("--local_epochs", default=1, type=int)
+    parser.add_argument("--conv_impl", default=None, type=str,
+                        choices=["direct", "im2col"],
+                        help="engine conv lowering: direct (default) | im2col "
+                             "(numerically equivalent; much faster for the "
+                             "client-vmapped round on CPU hosts)")
     parser.add_argument("--skip", default="", type=str,
                         help="'reference' or 'mine': emit only the other side")
     args = parser.parse_args(argv)
     if args.model == "transformer":
         # vision-only flags are ignored on the LM path -- loudly, not silently
-        for flag, attr in (("--n_test", "n_test"), ("--hidden", "hidden")):
+        for flag, attr in (("--n_test", "n_test"), ("--hidden", "hidden"),
+                           ("--conv_impl", "conv_impl")):
             if getattr(args, attr) != parser.get_default(attr):
                 print(f"warning: {flag} is ignored for --model transformer "
                       f"(use --n_test_tokens / --emb instead)", file=sys.stderr)
@@ -418,6 +428,8 @@ def main(argv=None):
                                         frac=args.frac, split_mode=args.split,
                                         local_epochs=args.local_epochs,
                                         mode=args.mode, model_split=args.model_split)
+        if args.conv_impl:
+            cfg["conv_impl"] = args.conv_impl
         ref = [] if args.skip == "reference" else \
             run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
         mine = [] if args.skip == "mine" else \
