@@ -258,6 +258,11 @@ def main():
         # the fallback's ONLY job is an honest-schema line, fast
         cfg["resnet"] = {"hidden_size": [8, 16, 16, 16]}
         degraded = "cpu-fallback-tiny-width"
+    if platform == "cpu":
+        # XLA:CPU executes the client-vmapped grouped conv catastrophically
+        # (measured 3.7x round slowdown); the numerically-identical im2col
+        # lowering is the right default off-TPU (MEASUREMENTS.md round 4)
+        cfg["conv_impl"] = os.environ.get("BENCH_CONV_IMPL", "im2col")
 
     ds = fetch_dataset("CIFAR10", synthetic=True, seed=0,
                        synthetic_sizes={"train": n_train, "test": 1000})

@@ -439,8 +439,12 @@ def main(argv=None):
             report["final_gap_pp"] = round(mine[-1] - ref[-1], 2)
     print(json.dumps(report))
     if args.out:
-        with open(args.out, "w") as f:
+        # atomic: campaign runners resume by artifact-exists, so a kill
+        # mid-write must never leave a truncated artifact that reads as done
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(report, f)
+        os.replace(tmp, args.out)
     return report
 
 

@@ -4,66 +4,30 @@
 One process so a TPU run claims the tunnel once; on CPU set JAX_PLATFORMS=cpu
 and a persistent JAX_COMPILATION_CACHE_DIR.
 
-Usage: run_parity_r3_mine.py [mnist|cifar|modes]  (default: all, in
-pairing-priority order).  Finished artifacts are skipped, so a killed
-campaign resumes where it left off.
+Usage: run_parity_r3_mine.py [mnist|cifar|modes]  (default: all, in the
+pairing-priority order of parity_r4_specs.RUNS).  Finished artifacts are
+skipped, so a killed campaign resumes where it left off.  On CPU hosts the
+engine uses the im2col conv lowering (numerically equivalent, measured 3.7x
+faster there -- MEASUREMENTS.md round 4).
 """
 
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from heterofl_tpu.analysis import compare_reference as cr
-
-MNIST_ARGS = ["--data", "MNIST", "--model", "conv", "--hidden", "64,128,256,512",
-              "--users", "100", "--frac", "0.1", "--rounds", "100",
-              "--local_epochs", "5", "--n_train", "2000", "--n_test", "1000",
-              "--skip", "reference",
-              "--conv_impl", "im2col"]
-CIFAR_ARGS = ["--data", "CIFAR10", "--model", "resnet18", "--hidden", "64,128",
-              "--users", "100", "--frac", "0.1", "--rounds", "100",
-              "--local_epochs", "1", "--n_train", "2000", "--n_test", "1000",
-              "--skip", "reference",
-              "--conv_impl", "im2col"]
-
-# the single source of run specs: (family, name, args, artifact path)
-RUNS = []
-for s in (0, 1, 2):
-    # pairing-priority order for a slow CPU fallback: alternate families so
-    # every finished run immediately pairs with an existing ref artifact
-    RUNS.append(("mnist", f"MNIST conv non-iid mine seed {s}",
-                 MNIST_ARGS + ["--split", "non-iid-2", "--seed", str(s)],
-                 f"/tmp/PARITY_R3_MINE_MNIST_NONIID_S{s}.json"))
-    RUNS.append(("cifar", f"CIFAR resnet18 mine seed {s}",
-                 CIFAR_ARGS + ["--seed", str(s)],
-                 f"/tmp/PARITY_R3_MINE_CIFAR_S{s}.json"))
-RUNS += [
-    ("modes", "MNIST dynamic a1-e1 mine",
-     MNIST_ARGS + ["--model_split", "dynamic", "--mode", "a1-e1", "--seed", "0"],
-     "/tmp/PARITY_R3_MINE_DYNAMIC_S0.json"),
-    ("modes", "MNIST interp a1-b9 mine",
-     MNIST_ARGS + ["--mode", "a1-b9", "--seed", "0"],
-     "/tmp/PARITY_R3_MINE_INTERP_A1B9_S0.json"),
-    ("modes", "MNIST interp a5-e5 mine",
-     MNIST_ARGS + ["--mode", "a5-e5", "--seed", "0"],
-     "/tmp/PARITY_R3_MINE_INTERP_A5E5_S0.json"),
-]
-
-
-def _run(name, args, out):
-    if os.path.exists(out):
-        print(f"=== skip {name} (artifact exists) ===", flush=True)
-        return
-    print(f"=== {name} ===", flush=True)
-    cr.main(args + ["--out", out])
+from parity_r4_specs import RUNS, run_one
 
 
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else None
     for family, name, args, out in RUNS:
         if only in (None, family):
-            _run(name, args, out)
+            run_one(cr.main, name, args, out,
+                    extra_args=("--conv_impl", "im2col"),
+                    log=lambda m: print(m, flush=True))
     print("=== ALL_R3_MINE_DONE ===", flush=True)
 
 

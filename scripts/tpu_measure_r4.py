@@ -40,10 +40,10 @@ def emit(rec):
 
 
 def main():
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     ".jax_cache", "measure"))
+    # share bench.py's fingerprinted cache dir: a successful session
+    # pre-warms the driver's end-of-round bench compile
+    import bench as _bench
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _bench._cache_dir())
     import jax
     import jax.numpy as jnp
 

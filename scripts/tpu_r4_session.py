@@ -32,10 +32,10 @@ def _watchdog():
 
 def main():
     global CLAIMED
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     ".jax_cache", "tpu"))
+    # share bench.py's fingerprinted cache dir: a successful session
+    # pre-warms the driver's end-of-round bench compile
+    import bench as _bench
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _bench._cache_dir())
     threading.Thread(target=_watchdog, daemon=True).start()
     t0 = time.time()
     print("tpu_r4_session: claiming devices...", file=sys.stderr, flush=True)
@@ -55,42 +55,17 @@ def main():
 
     from heterofl_tpu.analysis import compare_reference as cr
 
-    MNIST = ["--data", "MNIST", "--model", "conv", "--hidden", "64,128,256,512",
-             "--users", "100", "--frac", "0.1", "--rounds", "100",
-             "--local_epochs", "5", "--n_train", "2000", "--n_test", "1000",
-             "--skip", "reference"]
-    CIFAR = ["--data", "CIFAR10", "--model", "resnet18", "--hidden", "64,128",
-             "--users", "100", "--frac", "0.1", "--rounds", "100",
-             "--local_epochs", "1", "--n_train", "2000", "--n_test", "1000",
-             "--skip", "reference"]
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from parity_r4_specs import RUNS, run_one
 
-    runs = []
-    for s in (0, 1, 2):
-        runs.append((f"MNIST non-iid S{s}",
-                     MNIST + ["--split", "non-iid-2", "--seed", str(s),
-                              "--out", f"/tmp/PARITY_R3_MINE_MNIST_NONIID_S{s}.json"]))
-    runs.append(("MNIST dynamic", MNIST + ["--model_split", "dynamic", "--mode", "a1-e1",
-                                           "--seed", "0", "--out", "/tmp/PARITY_R3_MINE_DYNAMIC_S0.json"]))
-    runs.append(("MNIST interp a1-b9", MNIST + ["--mode", "a1-b9", "--seed", "0",
-                                                "--out", "/tmp/PARITY_R3_MINE_INTERP_A1B9_S0.json"]))
-    runs.append(("MNIST interp a5-e5", MNIST + ["--mode", "a5-e5", "--seed", "0",
-                                                "--out", "/tmp/PARITY_R3_MINE_INTERP_A5E5_S0.json"]))
-    for s in (0, 1, 2):
-        runs.append((f"CIFAR resnet18 S{s}",
-                     CIFAR + ["--seed", str(s),
-                              "--out", f"/tmp/PARITY_R3_MINE_CIFAR_S{s}.json"]))
+    def log(msg):
+        print(f"tpu_r4_session: {msg}", file=sys.stderr, flush=True)
 
-    for name, args in runs:
-        out = args[args.index("--out") + 1]
-        if os.path.exists(out):
-            print(f"tpu_r4_session: skip {name} (artifact exists)",
-                  file=sys.stderr, flush=True)
-            continue
+    for _family, name, args, out in RUNS:
         t = time.time()
-        print(f"tpu_r4_session: campaign {name} ...", file=sys.stderr, flush=True)
-        cr.main(args)
-        print(f"tpu_r4_session: campaign {name} done in {time.time() - t:.0f}s",
-              file=sys.stderr, flush=True)
+        # on the TPU the direct conv lowering is the measured product default
+        if run_one(cr.main, name, args, out, log=log):
+            log(f"campaign {name} done in {time.time() - t:.0f}s")
 
     print("tpu_r4_session: measurements ...", file=sys.stderr, flush=True)
     import importlib
