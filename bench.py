@@ -180,9 +180,21 @@ def _supervise() -> int:
         print("bench: TPU attempts failed (wedged tunnel?); falling back to "
               "tiny CPU run", file=sys.stderr)
 
-    # CPU fallback: tiny model + shrunk round so it prints in ~2 min.  Never
-    # overrun the deadline -- a driver killing us at the deadline would lose
-    # even the last-resort record.
+    # CPU fallbacks (VERDICT r4 item 5): first try the REAL flagship program
+    # -- 100 users, 10 active clients, full ResNet-18 widths -- with only the
+    # per-round data volume cut so it can print on a single core (slow but
+    # *about the right program*, honestly labelled).  Only if there is no
+    # budget for that, or it wedges, run the tiny-width insurance line.
+    # Both are `degraded` and report vs_baseline: null.
+    tiny_reserve = 200  # keep room for the tiny insurance child + slack
+    real_budget = remaining() - tiny_reserve
+    if real_budget >= 420:
+        print(f"bench: CPU real-width attempt (budget {real_budget:.0f}s)",
+              file=sys.stderr)
+        if run_child({"BENCH_CPU": "1", "BENCH_REALWIDTH": "1"}, real_budget):
+            return 0
+        print("bench: real-width CPU run did not finish; tiny fallback",
+              file=sys.stderr)
     cpu_budget = remaining() - 15
     if cpu_budget >= 20 and run_child({"BENCH_CPU": "1", "BENCH_FALLBACK": "1"},
                                       cpu_budget):
@@ -213,6 +225,7 @@ def main():
               file=sys.stderr, flush=True)
 
     fallback = os.environ.get("BENCH_FALLBACK") == "1"
+    realwidth = os.environ.get("BENCH_REALWIDTH") == "1"
     if os.environ.get("BENCH_CPU") == "1":
         _force_cpu()
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
@@ -234,11 +247,14 @@ def main():
     platform = devs[0].platform
     hb(f"devices acquired: {len(devs)}x {platform}")
 
-    # The fallback must PRINT within ~2 min on CPU: tiny widths compile in
-    # ~20s and 20 users x 2000 imgs gives 50 local steps/round.
-    users = int(os.environ.get("BENCH_USERS", "20" if fallback else "100"))
-    n_train = int(os.environ.get("BENCH_SYNTH_N", "2000" if fallback else "50000"))
-    timed_rounds = int(os.environ.get("BENCH_ROUNDS", "2" if fallback else "5"))
+    # Both CPU fallbacks keep the flagship's 100-user/10-active federation
+    # structure (VERDICT r4 item 5); the tiny one shrinks widths for a fast
+    # insurance line, the real-width one shrinks only per-round data volume.
+    users = int(os.environ.get("BENCH_USERS", "100"))
+    n_train = int(os.environ.get("BENCH_SYNTH_N",
+                                 "2000" if (fallback or realwidth) else "50000"))
+    timed_rounds = int(os.environ.get("BENCH_ROUNDS",
+                                      "1" if realwidth else "2" if fallback else "5"))
 
     cfg = C.default_cfg()
     cfg["control"] = C.parse_control_name(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
@@ -253,9 +269,15 @@ def main():
     degraded = None
     if hidden:  # debug-only shrink, e.g. BENCH_HIDDEN=8,16,16,16
         cfg["resnet"] = {"hidden_size": [int(h) for h in hidden.split(",")]}
+        degraded = f"hidden-shrink-{hidden}"  # never comparable to baseline
+    elif platform == "cpu" and realwidth:
+        # flagship widths and federation structure; only the per-client data
+        # volume (and with it local steps/round) is cut so a single core can
+        # print inside the deadline -- slow but the right program
+        degraded = "cpu-real-width-short-shards"
+        cfg["num_epochs"] = dict(cfg["num_epochs"], local=1)
     elif platform == "cpu":
-        # even quarter-width ResNet-18 can take >5 min to compile on CPU;
-        # the fallback's ONLY job is an honest-schema line, fast
+        # tiny-width insurance line: must PRINT within ~2 min even cold
         cfg["resnet"] = {"hidden_size": [8, 16, 16, 16]}
         degraded = "cpu-fallback-tiny-width"
     if platform == "cpu":
@@ -286,15 +308,19 @@ def main():
         return params, ms
 
     def emit(rps, dt, compile_s, ms, rounds_done):
+        # a degraded (non-flagship-volume / wrong-platform) run must not
+        # pretend to be comparable to the 10 rps north star (VERDICT r4
+        # item 5): vs_baseline is null unless this is the real program
         loss = float(np.asarray(ms["loss_sum"]).sum() / np.asarray(ms["n"]).sum())
         print(json.dumps({
             "metric": "federated_rounds_per_sec_cifar10_resnet18_a1-e1_100c",
             "value": round(rps, 4),
             "unit": "rounds/sec",
-            "vs_baseline": round(rps / 10.0, 4),
+            "vs_baseline": None if degraded else round(rps / 10.0, 4),
             "extra": {"round_sec": round(dt, 3), "compile_sec": round(compile_s, 1),
                       "devices": len(devs), "platform": platform,
-                      "active_clients": n_active, "final_loss": round(loss, 4),
+                      "active_clients": n_active, "users": users,
+                      "n_train": n_train, "final_loss": round(loss, 4),
                       "rounds_timed": rounds_done,
                       **({"degraded": degraded} if degraded else {})},
         }), flush=True)

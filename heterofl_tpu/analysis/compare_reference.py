@@ -156,6 +156,9 @@ def run_reference(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> 
                               "label": torch.tensor(ds["test"].target)})
             correct = (out["score"].argmax(1).numpy() == ds["test"].target).mean()
         accs.append(float(correct * 100))
+        if r % 5 == 0 or r == rounds - 1:
+            print(f"ref round {r + 1}/{rounds} acc {accs[-1]:.1f}",
+                  file=sys.stderr, flush=True)
     return accs
 
 
@@ -348,6 +351,11 @@ def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[
         bn = ev.sbn_stats(params, xb, wb)
         g = ev.eval_global(params, bn, xg, yg, wg)
         accs.append(100.0 * g["score_sum"] / max(g["n"], 1.0))
+        if r % 5 == 0 or r == rounds - 1:
+            # liveness + trajectory on stderr: multi-hour campaigns are
+            # otherwise silent until the final JSON line
+            print(f"mine round {r + 1}/{rounds} acc {accs[-1]:.1f}",
+                  file=sys.stderr, flush=True)
     return accs
 
 

@@ -89,7 +89,9 @@ DEFAULT_CFG: Dict[str, Any] = {
     # dependency-light, ON matching the reference when tensorboard is present
     "use_tensorboard": False,
     # TPU-native extras (no reference counterpart):
-    "strategy": "masked",  # "masked" (one program, channel masks) | "sliced"
+    # "masked" (one program, channel masks), "grouped" (rate-grouped dense
+    # per-level programs on the mesh), "sliced" (host-orchestrated debug twin)
+    "strategy": "masked",
     # "sharded": per-user train stacks live sharded over the clients axis and
     # every client trains on the device owning its shard (device memory scales
     # as U/n_devices); "replicated": all shards on every device.

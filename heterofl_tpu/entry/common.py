@@ -153,13 +153,17 @@ class FedExperiment:
         self.num_active = int(np.ceil(cfg["frac"] * cfg["num_users"]))
         self._round_times: List[float] = []  # steady-state round durations (ETA)
         self._first_round_done = False
-        if cfg.get("strategy", "masked") not in ("masked", "sliced"):
+        if cfg.get("strategy", "masked") not in ("masked", "sliced", "grouped"):
             raise ValueError(f"Not valid strategy: {cfg.get('strategy')!r}")
-        self.sliced = None
+        self.alt_engine = None
         if cfg.get("strategy") == "sliced":
             from ..fed.sliced import SlicedFederation
 
-            self.sliced = SlicedFederation(cfg)
+            self.alt_engine = SlicedFederation(cfg)
+        elif cfg.get("strategy") == "grouped":
+            from ..parallel.grouped import GroupedRoundEngine
+
+            self.alt_engine = GroupedRoundEngine(cfg, self.mesh)
 
     # -- staging -------------------------------------------------------
 
@@ -169,7 +173,7 @@ class FedExperiment:
 
     def _place(self, data):
         """Train stacks onto devices per ``cfg['data_placement']``."""
-        if self.cfg.get("data_placement") == "sharded" and self.sliced is None:
+        if self.cfg.get("data_placement") == "sharded" and self.alt_engine is None:
             from ..parallel import shard_client_data
 
             return shard_client_data(self.mesh, data)
@@ -226,13 +230,18 @@ class FedExperiment:
         if profiling:
             self._profiled = True
             jax.profiler.start_trace(self.cfg["profile_dir"])
-        if self.sliced is not None:
+        if self.alt_engine is not None:
             rates = np.asarray(sample_model_rates(jax.random.fold_in(key, 7), self.cfg,
                                                   jnp.asarray(user_idx)))
-            new_np, ms = self.sliced.train_round(
-                {k: np.asarray(v) for k, v in params.items()}, user_idx, rates,
-                self.train_data, lr, key)
-            params = {k: jnp.asarray(v) for k, v in new_np.items()}
+            if self.cfg.get("strategy") == "grouped":
+                # mesh-native: params stay on device end to end
+                params, ms = self.alt_engine.train_round(
+                    params, user_idx, rates, self.train_data, lr, key)
+            else:
+                new_np, ms = self.alt_engine.train_round(
+                    {k: np.asarray(v) for k, v in params.items()}, user_idx, rates,
+                    self.train_data, lr, key)
+                params = {k: jnp.asarray(v) for k, v in new_np.items()}
         else:
             params, ms = self.engine.train_round(params, key, lr, user_idx, self.train_data)
             ms = {k: np.asarray(v) for k, v in ms.items()}

@@ -95,6 +95,81 @@ def combine_counted(global_params: Dict[str, jnp.ndarray],
 
 
 # ---------------------------------------------------------------------------
+# Sliced strategy, in-jit half (static prefix slices / zero-pad embeds)
+#
+# HeteroFL's index sets are always nested prefixes (ref fed.py:46-48) or
+# per-head prefixes (ref fed.py:124-131), so at a *static* width rate the
+# reference's gather ``v[meshgrid(idx)]`` is a static XLA slice and the
+# scatter-back is a zero pad -- no gather/scatter ops, fully fusible.  These
+# power the mesh-native rate-grouped engine (parallel/grouped.py).
+# ---------------------------------------------------------------------------
+
+def _per_head_counts(group: Group, width_rate: float) -> tuple:
+    hd = group.size // group.num_heads
+    return hd, int(math.ceil(hd * width_rate))
+
+
+def slice_axis(v: jnp.ndarray, group: Group, width_rate: float, axis: int) -> jnp.ndarray:
+    """Slice one tensor axis to its active prefix at a static ``width_rate``."""
+    if group.kind == "full":
+        return v
+    if group.kind == "prefix":
+        k = int(math.ceil(group.size * width_rate))
+        return jax.lax.slice_in_dim(v, 0, k, axis=axis)
+    if group.kind == "per_head":
+        hd, kh = _per_head_counts(group, width_rate)
+        shp = v.shape
+        v = v.reshape(shp[:axis] + (group.num_heads, hd) + shp[axis + 1:])
+        v = jax.lax.slice_in_dim(v, 0, kh, axis=axis + 1)
+        return v.reshape(shp[:axis] + (group.num_heads * kh,) + shp[axis + 1:])
+    raise ValueError(group.kind)
+
+
+def pad_axis(v: jnp.ndarray, group: Group, width_rate: float, axis: int) -> jnp.ndarray:
+    """Zero-pad one sliced axis back to full size (inverse of :func:`slice_axis`)."""
+    if group.kind == "full":
+        return v
+    pads = [(0, 0)] * v.ndim
+    if group.kind == "prefix":
+        k = int(math.ceil(group.size * width_rate))
+        pads[axis] = (0, group.size - k)
+        return jnp.pad(v, pads)
+    if group.kind == "per_head":
+        hd, kh = _per_head_counts(group, width_rate)
+        shp = v.shape
+        v = v.reshape(shp[:axis] + (group.num_heads, kh) + shp[axis + 1:])
+        pads = [(0, 0)] * v.ndim
+        pads[axis + 1] = (0, hd - kh)
+        v = jnp.pad(v, pads)
+        return v.reshape(shp[:axis] + (group.size,) + shp[axis + 1:])
+    raise ValueError(group.kind)
+
+
+def extract_sliced_jnp(params: Dict[str, jnp.ndarray], specs: Dict[str, ParamSpec],
+                       groups: Dict[str, Group], width_rate: float) -> Dict[str, jnp.ndarray]:
+    """In-jit sub-model extraction at a static rate (the traced twin of
+    :func:`extract_sliced`; ref fed.py:165-178)."""
+    out = {}
+    for k, v in params.items():
+        for axis, gname in sorted(specs[k].axis_groups.items()):
+            v = slice_axis(v, groups[gname], width_rate, axis)
+        out[k] = v
+    return out
+
+
+def embed_sliced_jnp(sliced: Dict[str, jnp.ndarray], specs: Dict[str, ParamSpec],
+                     groups: Dict[str, Group], width_rate: float) -> Dict[str, jnp.ndarray]:
+    """In-jit zero-pad of sliced tensors back to global shapes (the traced
+    twin of :func:`embed_sliced`)."""
+    out = {}
+    for k, v in sliced.items():
+        for axis, gname in sorted(specs[k].axis_groups.items()):
+            v = pad_axis(v, groups[gname], width_rate, axis)
+        out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Sliced strategy (host-side gather/scatter, reference-shaped sub-models)
 # ---------------------------------------------------------------------------
 

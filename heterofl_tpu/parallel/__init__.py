@@ -1,2 +1,3 @@
+from .grouped import GroupedRoundEngine  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from .round_engine import RoundEngine, shard_client_data  # noqa: F401

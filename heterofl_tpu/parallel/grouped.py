@@ -1,0 +1,208 @@
+"""Rate-grouped sliced execution ON the mesh: dense per-level programs,
+device-resident aggregation, no host round-trips.
+
+The masked engine (round_engine.py) runs every client at full width with
+channel masks -- uniform shapes, but a ~3.9x FLOP overhead at the canonical
+a1-e1 mix (MEASUREMENTS.md roofline): a rate-1/16 client's conv FLOPs are
+(1/16)^2 of full width, yet the masked program spends full-width FLOPs on it.
+This engine realises the roofline's "group clients by rate level" design:
+
+  * active clients are grouped by rate level on the host (level membership is
+    data, not shape -- grouping is O(A) bookkeeping);
+  * each level runs ONE jitted ``shard_map`` program: extract the level's
+    dense sub-model from the global params (static prefix slices,
+    ``fed.core.extract_sliced_jnp``), vmap the level's clients through dense
+    local SGD at the level's own small shapes -- client slots sharded over
+    the ``clients`` mesh axis -- then ``psum`` the level's counted sums and
+    zero-pad them back to global shape (``embed_sliced_jnp``);
+  * a final jitted combine merges the level partials into the new globals
+    (counted average + stale rule, semantics = ref fed.py:180-298).
+
+All intermediates are device arrays: the host only *dispatches* the L+1
+programs per round; no parameter or data bytes move through it.  Programs
+are cached per (rate, slot-count) with slot counts bucketed to powers of
+two, so the compile space is O(levels x log A) -- NOT the cross-product of
+per-level counts (a per-round-pattern mega-program would recompile
+combinatorially as the sampled mix varies round to round).
+
+Client PRNG keys are ``fold_in(key, 13 + global_uid)`` -- the masked
+engine's convention -- so with the same inputs both engines produce the same
+new global parameters (tests/test_grouped.py) up to float association.
+
+Trade-off vs masked: dense per-level compute wins when active-clients /
+devices >> number of levels (the pod regime); at tiny occupancy the
+per-level padding to the axis size erodes the win.  Both engines share the
+aggregation algebra, so the choice is per-experiment (``cfg['strategy']``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..fed.core import combine_counted, embed_sliced_jnp, extract_sliced_jnp
+from ..models import make_model
+from ..models.spec import count_masks as make_count_masks
+from .round_engine import RoundEngine, _ceil_div, _shard_map
+
+
+def _bucket_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class GroupedRoundEngine:
+    """Mesh-native sliced strategy: same public round signature as
+    ``fed.sliced.SlicedFederation`` (host-side rates in, per-slot metrics
+    out), but every program runs on the mesh and aggregation state never
+    leaves the devices."""
+
+    def __init__(self, cfg: Dict[str, Any], mesh):
+        if cfg.get("data_placement", "replicated") == "sharded":
+            raise ValueError("grouped strategy needs replicated data placement "
+                             "(a level's clients span the whole clients axis); "
+                             "use the masked engine for sharded placement")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.global_rate = cfg["global_model_rate"]
+        self.global_model = make_model(cfg)
+        self.is_lm = self.global_model.meta.get("kind") == "transformer"
+        self.failure_rate = float(cfg.get("client_failure_rate", 0.0) or 0.0)
+        self.levels: Dict[float, Tuple[Any, RoundEngine]] = {}
+        for rate in sorted({float(r) for r in cfg["model_rate"]}, reverse=True):
+            model = make_model(cfg, model_rate=rate)
+            self.levels[rate] = (model, RoundEngine(model, cfg, mesh=None))
+        self._level_progs: Dict[Tuple[float, int], Any] = {}
+        self._combine_progs: Dict[int, Any] = {}
+
+    # -- per-level program ---------------------------------------------
+
+    def _level_prog(self, rate: float, slots: int):
+        """Jitted shard_map for one (rate level, slot count): dense local
+        training of ``slots`` clients (sharded over the clients axis) and the
+        level's counted-sum partial, embedded to global shape."""
+        key_ = (rate, slots)
+        if key_ in self._level_progs:
+            return self._level_progs[key_]
+        gm = self.global_model
+        model_l, eng_l = self.levels[rate]
+        wr = rate / self.global_rate  # static for this program
+        mesh = self.mesh
+        n_data = mesh.shape["data"]
+        data_axis = "data" if n_data > 1 else None
+
+        def body(params, key, lr, uarr, *data):
+            lm_all = data[-1]
+            valid = (uarr >= 0).astype(jnp.float32)
+            ugid = jnp.maximum(uarr, 0)
+            if self.failure_rate > 0.0:
+                # same crash model + PRNG stream as the masked engine
+                fkey = jax.random.fold_in(key, 98)
+                alive = 1.0 - jax.vmap(
+                    lambda u: jax.random.bernoulli(
+                        jax.random.fold_in(fkey, u), self.failure_rate)
+                )(ugid).astype(jnp.float32)
+                valid = valid * alive
+            sub = extract_sliced_jnp(params, gm.specs, gm.groups, wr)
+            slot_keys = jax.vmap(lambda u: jax.random.fold_in(key, 13 + u))(ugid)
+            lm = lm_all[ugid]
+            if self.is_lm:
+                rows = data[0][ugid]
+                trained, ms = jax.vmap(
+                    lambda r_, l_, k_: eng_l._local_train_lm(
+                        sub, 1.0, r_, l_, k_, lr, scaler_rate=wr,
+                        data_axis=data_axis, n_data=n_data)
+                )(rows, lm, slot_keys)
+            else:
+                xs, ys, sms = data[0][ugid], data[1][ugid], data[2][ugid]
+                trained, ms = jax.vmap(
+                    lambda x_, y_, m_, l_, k_: eng_l._local_train_vision(
+                        sub, 1.0, x_, y_, m_, l_, k_, lr, scaler_rate=wr,
+                        data_axis=data_axis, n_data=n_data)
+                )(xs, ys, sms, lm, slot_keys)
+            # counted sums in SLICED shape (within the slice the width mask
+            # is all-ones by construction; only the label-split restriction
+            # remains), then one zero-pad embed for the whole level
+            sub_shapes = {k: v.shape for k, v in sub.items()}
+            cms = jax.vmap(lambda l_, v_: jax.tree_util.tree_map(
+                lambda m: m * v_,
+                make_count_masks(sub_shapes, model_l.specs, model_l.groups, 1.0, l_)))(
+                lm, valid)
+            sum_l = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in sub}
+            cnt_l = {k: jnp.sum(cms[k], axis=0) for k in sub}
+            sum_l = jax.lax.psum(sum_l, "clients")
+            cnt_l = jax.lax.psum(cnt_l, "clients")
+            sum_l = embed_sliced_jnp(sum_l, gm.specs, gm.groups, wr)
+            cnt_l = embed_sliced_jnp(cnt_l, gm.specs, gm.groups, wr)
+            ms = {k: v * valid for k, v in ms.items()}
+            ms["rate"] = jnp.full(uarr.shape, rate, jnp.float32) * valid
+            return sum_l, cnt_l, ms
+
+        data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
+        fn = _shard_map(
+            body, mesh,
+            in_specs=(P(), P(), P(), P("clients")) + data_specs,
+            out_specs=(P(), P(), P("clients")),
+        )
+        prog = jax.jit(fn)
+        self._level_progs[key_] = prog
+        return prog
+
+    def _combine_prog(self, n_levels: int):
+        """Jitted merge of ``n_levels`` level partials into the new globals."""
+        if n_levels in self._combine_progs:
+            return self._combine_progs[n_levels]
+
+        def merge(params, sums, cnts):
+            summed = jax.tree_util.tree_map(lambda *xs: sum(xs), *sums)
+            counts = jax.tree_util.tree_map(lambda *xs: sum(xs), *cnts)
+            return combine_counted(params, summed, counts)
+
+        prog = jax.jit(merge, donate_argnums=(0, 1, 2))
+        self._combine_progs[n_levels] = prog
+        return prog
+
+    # -- host wrapper ---------------------------------------------------
+
+    def train_round(self, global_params: Dict[str, Any], user_idx: np.ndarray,
+                    rates: np.ndarray, data: Tuple, lr: float, key):
+        """One round.  ``data`` is the replicated stacked tuple the masked
+        engine takes; ``rates`` are the active users' absolute rates (host
+        side, same PRNG stream as the masked engine's in-jit draw)."""
+        n_dev = self.mesh.shape["clients"]
+        user_idx = np.asarray(user_idx, np.int32)
+        rates = np.asarray(rates, np.float64)
+        by_level: Dict[float, List[int]] = {}
+        for pos, r in enumerate(rates):
+            by_level.setdefault(float(r), []).append(pos)
+
+        args = tuple(jnp.asarray(a) for a in data)
+        lr = jnp.asarray(lr, jnp.float32)
+        sums, cnts, ms_levels, positions = [], [], [], []
+        for rate in sorted(by_level, reverse=True):
+            pos = by_level[rate]
+            slots = _bucket_pow2(_ceil_div(len(pos), n_dev)) * n_dev
+            u = -np.ones(slots, np.int32)
+            u[: len(pos)] = user_idx[pos]
+            sum_l, cnt_l, ms = self._level_prog(rate, slots)(
+                global_params, key, lr, jnp.asarray(u), *args)
+            sums.append(sum_l)
+            cnts.append(cnt_l)
+            ms_levels.append(ms)
+            positions.append(pos)
+        new_params = self._combine_prog(len(sums))(global_params, sums, cnts)
+
+        n_slots = len(user_idx)
+        metrics = {k: np.zeros(n_slots, np.float32)
+                   for k in ("loss_sum", "score_sum", "n", "rate")}
+        for pos, ms in zip(positions, ms_levels):
+            for k in metrics:
+                metrics[k][pos] = np.asarray(ms[k])[: len(pos)]
+        return new_params, metrics

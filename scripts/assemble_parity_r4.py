@@ -59,15 +59,17 @@ def main():
             gaps.append(curve_gap)
         if finals_ref:
             # seeds can carry different round counts (a killed run truncates
-            # its curve); align to the shortest before stacking
+            # its curve); the curve stat aligns to the shortest, but the
+            # final gap is each seed's OWN last round so it always agrees
+            # with the per-seed artifacts (ADVICE r4)
             n_min = min(len(r) for r in gaps)
             g = np.array([r[:n_min] for r in gaps])
             summary[name] = {
                 "seeds": len(finals_ref),
                 "ref_final": f"{np.mean(finals_ref):.2f}±{np.std(finals_ref):.2f}",
                 "mine_final": f"{np.mean(finals_mine):.2f}±{np.std(finals_mine):.2f}",
-                "final_gap_pp": f"{np.mean(g[:, -1]):+.2f}",
-                "mean_abs_curve_gap_pp": f"{np.mean(np.abs(g)):.2f}",
+                "final_gap_pp": f"{np.mean([r[-1] for r in gaps]):+.2f}",
+                "mean_abs_curve_gap_pp": f"{np.mean(np.abs(g)):.2f} (aligned to {n_min} rounds)",
             }
     print(json.dumps(summary, indent=1))
     # decile curve table for PARITY.md (mean across seeds at rounds 10..100)

@@ -23,10 +23,16 @@ from parity_r4_specs import RUNS, run_one
 
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else None
+    # im2col is the CPU-host lowering (3.7x there, MEASUREMENTS.md round 4);
+    # on a TPU host the default direct conv is the right one, so gate on the
+    # platform jax actually selects (ADVICE r4).  default_backend() performs
+    # the device claim, which this campaign process needs anyway.
+    import jax
+
+    extra = ("--conv_impl", "im2col") if jax.default_backend() == "cpu" else ()
     for family, name, args, out in RUNS:
         if only in (None, family):
-            run_one(cr.main, name, args, out,
-                    extra_args=("--conv_impl", "im2col"),
+            run_one(cr.main, name, args, out, extra_args=extra,
                     log=lambda m: print(m, flush=True))
     print("=== ALL_R3_MINE_DONE ===", flush=True)
 

@@ -51,7 +51,10 @@ def main():
         return 4
     # the CPU fallback twin of this campaign (run_parity_r3_mine.py) is now
     # redundant and would fight this session for the single core
-    os.system("pkill -f run_parity_r3_mine 2>/dev/null")
+    # anchored pattern: a bare filename match can kill unrelated processes
+    # (an editor/tail/grep touching the file) -- ADVICE r4.  Interpreter
+    # flags like `python -u` may sit between the binary and the script path.
+    os.system(r"pkill -f 'python[0-9.]*( -[^ ]+)* [^ ]*run_parity_r3_mine\.py' 2>/dev/null")
 
     from heterofl_tpu.analysis import compare_reference as cr
 

@@ -74,6 +74,21 @@ def test_train_fed_sharded_placement(tmp_path):
     assert np.isfinite(hist["train/Local-Loss"]).all()
 
 
+def test_train_fed_grouped_strategy(tmp_path):
+    """The full fed entry with cfg strategy=grouped (rate-grouped dense
+    per-level programs on the mesh) trains, evaluates and checkpoints like
+    the masked default."""
+    from heterofl_tpu.entry import train_classifier_fed
+
+    argv = ["--control_name", "1_8_0.5_iid_fix_a1-b1-c1_bn_1_1",
+            "--data_name", "MNIST", "--model_name", "conv"] \
+        + _override(tmp_path, {"strategy": "grouped"})
+    res = train_classifier_fed.main(argv)
+    hist = res[0]["logger"].history
+    assert len(hist["test/Global-Accuracy"]) == 2
+    assert np.isfinite(hist["train/Local-Loss"]).all()
+
+
 def test_resume_modes(tmp_path):
     from heterofl_tpu.entry import train_classifier_fed
 
