@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Compiled-program FLOP account: masked vs rate-grouped engine at the
+flagship config (VERDICT r4 item 1 'done' bar).
+
+The round-4 roofline (MEASUREMENTS.md) derived ~72.7 TFLOP/round for the
+masked strategy vs ~18.6 for ideal dense per-level execution analytically;
+this script asks XLA itself: lower + compile both engines' round programs at
+the BASELINE.json config (CIFAR10 ResNet-18, hidden [64,128,256,512],
+100 users, 10 active, a1-b1-c1-d1-e1 -> 2 clients per level) and report
+``compile().cost_analysis()`` FLOPs.  CPU-safe: nothing is executed, only
+compiled.  Prints one JSON line; run under JAX_PLATFORMS=cpu with the axon
+env scrubbed (see tests/conftest.py).
+
+Usage: [SMALL=1] python scripts/grouped_flops.py   (SMALL=1: test widths)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heterofl_tpu import config as C
+from heterofl_tpu.data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
+from heterofl_tpu.models import make_model
+from heterofl_tpu.parallel import GroupedRoundEngine, RoundEngine, make_mesh
+
+
+def main():
+    small = os.environ.get("SMALL") == "1"
+    users, n_train = (20, 2000) if small else (100, 50000)
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    cfg["data_name"], cfg["model_name"], cfg["synthetic"] = "CIFAR10", "resnet18", True
+    cfg["compute_dtype"] = "bfloat16"
+    cfg = C.process_control(cfg)
+    if small:
+        cfg["resnet"] = {"hidden_size": [8, 16, 16, 16]}
+    cfg["classes_size"] = 10
+
+    ds = fetch_dataset("CIFAR10", synthetic=True, seed=0,
+                       synthetic_sizes={"train": n_train, "test": 100})
+    rng = np.random.default_rng(0)
+    split, lsplit = split_dataset(ds, users, "iid", rng)
+    x, y, m = stack_client_shards(ds["train"].data, ds["train"].target,
+                                  split["train"], list(range(users)))
+    lm = label_split_masks(lsplit, users, 10)
+    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    mesh = make_mesh(1, 1)
+    key, lr = jax.random.key(0), jnp.float32(0.1)
+
+    # active set: the expected mix, 2 clients per level (fix-mode rate vector
+    # is level-blocked: users [0..U/5) are level a, etc.)
+    rates_vec = np.asarray(cfg["model_rate"], np.float64)
+    user_idx = []
+    for r in sorted(set(rates_vec), reverse=True):
+        user_idx += list(np.where(rates_vec == r)[0][:2])
+    user_idx = np.asarray(user_idx, np.int32)
+    rates = rates_vec[user_idx]
+
+    eng = RoundEngine(model, cfg, mesh)
+    if eng._train is None:
+        eng._train = eng._build_train()
+    ug = jnp.asarray(user_idx)
+    args = tuple(data) + ((jnp.asarray(eng.fix_rates),) if eng.fix_rates is not None else ())
+    t0 = time.time()
+    masked = eng._train.lower(params, key, lr, ug, ug, *args).compile().cost_analysis()
+    t_masked = time.time() - t0
+    print(f"masked compiled in {t_masked:.0f}s: {masked['flops']:.3e} flops",
+          file=sys.stderr, flush=True)
+
+    grp = GroupedRoundEngine(cfg, mesh)
+    by = {}
+    for pos, r in enumerate(rates):
+        by.setdefault(float(r), []).append(pos)
+    per_level = {}
+    sums, cnts = [], []
+    t0 = time.time()
+    for r in sorted(by, reverse=True):
+        u = jnp.asarray(user_idx[by[r]])
+        prog = grp._level_prog(r, len(by[r]))
+        ca = prog.lower(params, key, lr, u, *data).compile().cost_analysis()
+        per_level[str(r)] = ca["flops"]
+        print(f"level {r}: {ca['flops']:.3e} flops", file=sys.stderr, flush=True)
+        # avals only (keeps the 'nothing is executed' contract): the combine
+        # lowering needs shapes/dtypes of the level partials, not values
+        s, c, _ = jax.eval_shape(prog, params, key, lr, u, *data)
+        sums.append(s)
+        cnts.append(c)
+    combine = grp._combine_prog(len(sums)).lower(params, sums, cnts).compile().cost_analysis()
+    t_grouped = time.time() - t0
+    grouped_total = sum(per_level.values()) + combine["flops"]
+    print(json.dumps({
+        "config": f"CIFAR10 resnet18 {cfg['resnet']['hidden_size']} "
+                  f"{users}u/10a a1-e1, batch {cfg['batch_size']['train']}, "
+                  f"local_epochs {cfg['num_epochs']['local']}, bf16",
+        "masked_flops_per_round": masked["flops"],
+        "grouped_flops_per_round": grouped_total,
+        "grouped_per_level_flops": per_level,
+        "combine_flops": combine["flops"],
+        "flop_ratio_masked_over_grouped": round(masked["flops"] / grouped_total, 3),
+        "compile_sec": {"masked": round(t_masked, 1), "grouped": round(t_grouped, 1)},
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Round-4 TPU measurement session (VERDICT r3 item 4): one tunnel claim,
-three measurements, one JSON line each (flushed immediately so a wedge keeps
-the partials):
+"""TPU measurement session (VERDICT r3 item 4 + r4 item 1): one tunnel
+claim, five measurements, one JSON line each (flushed immediately so a wedge
+keeps the partials):
 
 1. flagship-bench rehearsal  -- the BASELINE.json config (100-client CIFAR10
    ResNet-18 a1-e1, bf16) timed for rounds/sec; also warms the repo compile
@@ -16,6 +16,11 @@ the partials):
    steps are latency-bound and the fold buys nothing; (b)<<(a) means the
    batched-kernel lowering is the bottleneck and a block-diagonal/bmm conv
    path is the next optimization.
+4. engine-round variants     -- norm=none floor and the im2col conv lowering
+   timed through the real flagship round.
+5. rate-grouped engine A/B   -- dense per-level programs (parallel/grouped.py)
+   vs the masked round, best-vs-best round times (per-round lists reported
+   so per-bucket compile spikes are attributable).
 
 Peak FLOP/s table keyed by device_kind prefix; defaults to v5e bf16.
 """
@@ -97,12 +102,15 @@ def main():
     jax.block_until_ready(params)
     compile_s = time.time() - t0
     emit({"measure": "flagship_compile", "compile_sec": round(compile_s, 1)})
-    t0 = time.time()
+    masked_rounds = []
     for r in range(1, timed + 1):
+        t0 = time.time()
         params, ms = once(params, r)
         jax.block_until_ready(params)
-        dt = (time.time() - t0) / r
+        masked_rounds.append(time.time() - t0)
+        dt = sum(masked_rounds) / len(masked_rounds)
         emit({"measure": "flagship_round", "r": r, "avg_round_sec": round(dt, 3),
+              "round_sec": round(masked_rounds[-1], 3),
               "rounds_per_sec": round(1.0 / dt, 4)})
 
     # ---- 2. MFU from compiled-program FLOPs ------------------------------
@@ -251,6 +259,41 @@ def main():
     # per-client kernels for patch-extraction + batched matmul
     # (cfg conv_impl='im2col', ops/layers.py) and re-time the flagship round.
     time_engine_round("im2col_round", conv_impl="im2col")
+
+    # ---- 5. rate-grouped dense engine A/B (round 5) ----------------------
+    # The roofline's prescription realised (parallel/grouped.py): dense
+    # per-level programs vs the masked full-width round on the same inputs.
+    # Per-round times are reported individually because the per-level
+    # programs recompile per slot-count bucket -- warm rounds show the
+    # steady state, spikes show a fresh bucket.
+    from heterofl_tpu.parallel import GroupedRoundEngine
+
+    grp = GroupedRoundEngine(cfg, mesh)
+    rates_vec = np.asarray(cfg["model_rate"], np.float32)
+
+    def once_g(p, r):
+        uidx = srng.permutation(users)[:10].astype(np.int32)
+        return grp.train_round(p, uidx, rates_vec[uidx], data, 0.1, jax.random.key(r))
+
+    pg = model.init(jax.random.key(0))
+    t0 = time.time()
+    pg, _ = once_g(pg, 0)
+    jax.block_until_ready(pg)
+    emit({"measure": "grouped_compile", "compile_sec": round(time.time() - t0, 1)})
+    per_round = []
+    for r in range(1, 7 if not smoke else 2):
+        t0 = time.time()
+        pg, ms_g = once_g(pg, r)
+        jax.block_until_ready(pg)
+        per_round.append(round(time.time() - t0, 3))
+    warm = min(per_round)
+    masked_best = min(masked_rounds)  # best-vs-best, not avg-vs-best
+    emit({"measure": "grouped_round", "per_round_sec": per_round,
+          "best_round_sec": warm, "rounds_per_sec": round(1.0 / warm, 4),
+          "masked_per_round_sec": [round(t, 3) for t in masked_rounds],
+          "speedup_vs_masked_best": round(masked_best / warm, 3),
+          "loss": round(float(np.asarray(ms_g["loss_sum"]).sum()
+                              / max(float(np.asarray(ms_g["n"]).sum()), 1.0)), 4)})
     emit({"measure": "DONE"})
 
 
