@@ -1,15 +1,33 @@
 #!/bin/bash
 # Retry scripts/tpu_r4_session.py until the tunnel clears and the session
-# completes (or attempts run out).  Exit 3 from the session = claim wedged.
+# completes (or attempts run out).  Exit 3 from the session = claim wedged
+# (watchdog); other non-zero = fast failure (e.g. UNAVAILABLE from the
+# relay).  Fast failures burn no claim budget, so space them out and keep
+# trying for a whole working day rather than exhausting attempts in an hour.
 LOG=${1:-/tmp/tpu_r4_session.log}
+SLEEP=${TPU_RETRY_SLEEP:-600}
+ATTEMPTS=${TPU_RETRY_ATTEMPTS:-60}
+SLOW_BUDGET=${TPU_RETRY_SLOW_BUDGET:-6}   # attempts that burned a real claim
 cd /root/repo
-for i in $(seq 1 24); do
+slow=0
+for i in $(seq 1 "$ATTEMPTS"); do
   echo "=== r4 session attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
+  t0=$(date +%s)
   timeout 7200 python -u scripts/tpu_r4_session.py >> "$LOG" 2>&1
   rc=$?
-  echo "=== attempt $i rc=$rc $(date -u +%H:%M:%S) ===" >> "$LOG"
+  dur=$(( $(date +%s) - t0 ))
+  echo "=== attempt $i rc=$rc dur=${dur}s $(date -u +%H:%M:%S) ===" >> "$LOG"
   if [ "$rc" = "0" ]; then exit 0; fi
-  sleep 240
+  # a long failed attempt likely claimed the chip and wedged mid-session;
+  # those burn real claim budget and get a separate, smaller cap
+  if [ "$dur" -gt 900 ]; then
+    slow=$((slow + 1))
+    if [ "$slow" -ge "$SLOW_BUDGET" ]; then
+      echo "=== r4 session: $slow slow failures, stopping $(date -u +%H:%M:%S) ===" >> "$LOG"
+      exit 2
+    fi
+  fi
+  sleep "$SLEEP"
 done
 echo "=== r4 session gave up $(date -u +%H:%M:%S) ===" >> "$LOG"
 exit 1
