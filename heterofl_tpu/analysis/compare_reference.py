@@ -332,7 +332,14 @@ def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[
     model = make_model(cfg)
     params = model.init(jax.random.key(seed))
     mesh = make_mesh(min(len(jax.devices()), users), 1)
-    eng = RoundEngine(model, cfg, mesh)
+    grouped = cfg.get("strategy") == "grouped"
+    if grouped:
+        from ..fed.core import round_rates
+        from ..parallel import GroupedRoundEngine
+
+        eng = GroupedRoundEngine(cfg, mesh)
+    else:
+        eng = RoundEngine(model, cfg, mesh)
     # eval/sBN run UNvmapped (no per-client kernels), where the direct conv
     # lowering is the faster one; conv_impl only pays off inside the engine
     cfg_eval = dict(cfg)
@@ -346,8 +353,12 @@ def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[
     accs = []
     for r in range(rounds):
         user_idx = rng.permutation(users)[:n_active].astype(np.int32)
-        params, _ = eng.train_round(params, jax.random.fold_in(jax.random.key(seed), r),
-                                    lr, user_idx, data)
+        key_r = jax.random.fold_in(jax.random.key(seed), r)
+        if grouped:
+            rates = np.asarray(round_rates(key_r, cfg, jnp.asarray(user_idx)))
+            params, _ = eng.train_round(params, user_idx, rates, data, lr, key_r)
+        else:
+            params, _ = eng.train_round(params, key_r, lr, user_idx, data)
         bn = ev.sbn_stats(params, xb, wb)
         g = ev.eval_global(params, bn, xg, yg, wg)
         accs.append(100.0 * g["score_sum"] / max(g["n"], 1.0))
@@ -397,13 +408,18 @@ def main(argv=None):
                         help="engine conv lowering: direct (default) | im2col "
                              "(numerically equivalent; much faster for the "
                              "client-vmapped round on CPU hosts)")
+    parser.add_argument("--strategy", default="masked", type=str,
+                        choices=["masked", "grouped"],
+                        help="mine-side round engine: masked full-width (default) "
+                             "or rate-grouped dense per-level programs "
+                             "(parallel/grouped.py; round-equivalent)")
     parser.add_argument("--skip", default="", type=str,
                         help="'reference' or 'mine': emit only the other side")
     args = parser.parse_args(argv)
     if args.model == "transformer":
         # vision-only flags are ignored on the LM path -- loudly, not silently
         for flag, attr in (("--n_test", "n_test"), ("--hidden", "hidden"),
-                           ("--conv_impl", "conv_impl")):
+                           ("--conv_impl", "conv_impl"), ("--strategy", "strategy")):
             if getattr(args, attr) != parser.get_default(attr):
                 print(f"warning: {flag} is ignored for --model transformer "
                       f"(use --n_test_tokens / --emb instead)", file=sys.stderr)
@@ -438,6 +454,7 @@ def main(argv=None):
                                         mode=args.mode, model_split=args.model_split)
         if args.conv_impl:
             cfg["conv_impl"] = args.conv_impl
+        cfg["strategy"] = args.strategy
         ref = [] if args.skip == "reference" else \
             run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
         mine = [] if args.skip == "mine" else \

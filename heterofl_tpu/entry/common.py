@@ -33,7 +33,7 @@ from ..data import (
     stack_client_shards,
     stack_client_token_rows,
 )
-from ..fed.core import sample_model_rates
+from ..fed.core import round_rates
 from ..models import make_model
 from ..parallel import RoundEngine, make_mesh
 from ..parallel.evaluation import Evaluator
@@ -231,8 +231,7 @@ class FedExperiment:
             self._profiled = True
             jax.profiler.start_trace(self.cfg["profile_dir"])
         if self.alt_engine is not None:
-            rates = np.asarray(sample_model_rates(jax.random.fold_in(key, 7), self.cfg,
-                                                  jnp.asarray(user_idx)))
+            rates = np.asarray(round_rates(key, self.cfg, jnp.asarray(user_idx)))
             if self.cfg.get("strategy") == "grouped":
                 # mesh-native: params stay on device end to end
                 params, ms = self.alt_engine.train_round(

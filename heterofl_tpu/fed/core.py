@@ -60,6 +60,19 @@ def sample_model_rates(key: jax.Array, cfg: Dict[str, Any],
     raise ValueError("Not valid model split mode")
 
 
+ROUND_RATE_SALT = 7
+
+
+def round_rates(round_key: jax.Array, cfg: Dict[str, Any],
+                user_idx: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """The per-round rate draw, salt included: THE one definition of the
+    rate stream.  Used in-jit by the masked engine's dynamic branch and on
+    the host by ``entry/common.py`` and the parity harness for the grouped/
+    sliced engines -- all three must consume the identical stream or
+    round-level engine equivalence silently becomes a PRNG artifact."""
+    return sample_model_rates(jax.random.fold_in(round_key, ROUND_RATE_SALT), cfg, user_idx)
+
+
 def to_width_rates(model_rates: jnp.ndarray, cfg: Dict[str, Any]) -> jnp.ndarray:
     """Absolute model rate -> width/scaler rate relative to the global model
     (``scaler_rate = model_rate / global_model_rate``, ref fed.py:46,

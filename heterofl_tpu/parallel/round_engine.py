@@ -36,7 +36,7 @@ except ImportError:  # pragma: no cover
         return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 from ..data.datasets import DATASET_STATS
-from ..fed.core import combine_counted
+from ..fed.core import combine_counted, round_rates
 from .ring_attention import ring_attention
 from ..models.base import ModelDef
 from ..models.spec import count_masks as make_count_masks, mask_params, param_mask
@@ -317,10 +317,9 @@ class RoundEngine:
                 valid = valid * alive
             uidx = jnp.maximum(user_loc, 0)
             if dynamic:
-                rates_all = jnp.asarray(cfg["model_rate"], jnp.float32)
-                ridx = jax.random.choice(jax.random.fold_in(key, 7), len(cfg["model_rate"]),
-                                         shape=(num_users,), p=jnp.asarray(cfg["proportion"]))
-                rates_abs = rates_all[ridx][ugid]
+                # the shared per-round rate stream (fed.core.round_rates):
+                # re-roll ALL users, index the active ones (ref fed.py:15-24)
+                rates_abs = round_rates(key, cfg, ugid)
             else:
                 rates_abs = data[-1][ugid]  # fix_rates passed as last data arg
             wr = rates_abs / self.global_rate

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-5 non-iid calibration (VERDICT r4 item 4): the reference against
+# itself at the PARITY_R3_MNIST_NONIID config on extra seeds 3-5, to measure
+# the ref-vs-ref seed band that the +4.5pp mine-vs-ref mean gap must be
+# compared against.  nice'd below the CIFAR campaign on this single core.
+set -u
+cd /root/repo
+for s in 3 4 5; do
+  out=/tmp/PARITY_R5_REF_MNIST_NONIID_S$s.json
+  [ -f "$out" ] && { echo "skip seed $s"; continue; }
+  echo "=== MNIST conv non-iid ref seed $s $(date -u +%H:%M:%S) ==="
+  env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE -u AXON_LOOPBACK_RELAY \
+    JAX_PLATFORMS=cpu PYTHONPATH=/root/repo \
+    nice -n 12 python -u -m heterofl_tpu.analysis.compare_reference \
+      --data MNIST --model conv --hidden 64,128,256,512 --users 100 --frac 0.1 \
+      --split non-iid-2 --rounds 100 --local_epochs 5 --n_train 2000 --n_test 1000 \
+      --seed $s --skip mine --out "$out" 2>&1 | tail -2
+done
+echo "=== R5_REF_SEEDS_DONE $(date -u +%H:%M:%S) ==="

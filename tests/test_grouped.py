@@ -148,9 +148,9 @@ def test_grouped_failure_injection_matches_masked():
 def test_grouped_dynamic_mode_matches_masked():
     """Dynamic mode: the masked engine re-rolls rates in-jit from
     fold_in(key, 7); the grouped host wrapper receives rates drawn from the
-    same stream (fed.core.sample_model_rates, as entry/common.py does), so
-    the level grouping matches the in-jit draw and the rounds agree."""
-    from heterofl_tpu.fed.core import sample_model_rates
+    same stream (fed.core.round_rates, as entry/common.py does), so the
+    level grouping matches the in-jit draw and the rounds agree."""
+    from heterofl_tpu.fed.core import round_rates
 
     cfg, ds, data = _vision_setup(control="1_8_0.5_iid_dynamic_a1-b1-c1-d1-e1_bn_1_1")
     model = make_model(cfg)
@@ -158,8 +158,7 @@ def test_grouped_dynamic_mode_matches_masked():
     key, lr = jax.random.key(11), 0.05
     eng = RoundEngine(model, cfg, make_mesh(1, 1))
     new_m, ms_m = eng.train_round(model.init(jax.random.key(0)), key, lr, user_idx, data)
-    rates = np.asarray(sample_model_rates(jax.random.fold_in(key, 7), cfg,
-                                          jnp.asarray(user_idx)))
+    rates = np.asarray(round_rates(key, cfg, jnp.asarray(user_idx)))
     grp = GroupedRoundEngine(cfg, make_mesh(1, 1))
     new_g, ms_g = grp.train_round(model.init(jax.random.key(0)), user_idx, rates,
                                   data, lr, key)
