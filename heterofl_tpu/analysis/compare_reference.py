@@ -314,7 +314,8 @@ def run_mine_lm(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> Li
     return ppls
 
 
-def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[float]:
+def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float,
+             partial_out: str = None) -> List[float]:
     import jax
     import jax.numpy as jnp
 
@@ -367,6 +368,13 @@ def run_mine(cfg, ds, split, lsplit, rounds: int, seed: int, lr: float) -> List[
             # otherwise silent until the final JSON line
             print(f"mine round {r + 1}/{rounds} acc {accs[-1]:.1f}",
                   file=sys.stderr, flush=True)
+        if partial_out and (r % 10 == 9 or r == rounds - 1):
+            # salvageable partial curve for runs killed by the wall clock
+            # (atomic like the final artifact)
+            tmp = partial_out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"mine_acc": accs, "partial_through_round": r + 1}, f)
+            os.replace(tmp, partial_out)
     return accs
 
 
@@ -458,7 +466,8 @@ def main(argv=None):
         ref = [] if args.skip == "reference" else \
             run_reference(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
         mine = [] if args.skip == "mine" else \
-            run_mine(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr)
+            run_mine(cfg, ds, split, lsplit, args.rounds, args.seed, args.lr,
+                     partial_out=args.out + ".partial" if args.out else None)
         report = {"reference_acc": ref, "mine_acc": mine}
         if ref and mine:
             report["final_gap_pp"] = round(mine[-1] - ref[-1], 2)
@@ -470,6 +479,12 @@ def main(argv=None):
         with open(tmp, "w") as f:
             json.dump(report, f)
         os.replace(tmp, args.out)
+        # the final artifact supersedes the salvage checkpoint; a stale
+        # .partial left behind could be misattributed to a later retry
+        try:
+            os.remove(args.out + ".partial")
+        except FileNotFoundError:
+            pass
     return report
 
 

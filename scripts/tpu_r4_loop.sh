@@ -10,20 +10,26 @@ ATTEMPTS=${TPU_RETRY_ATTEMPTS:-60}
 SLOW_BUDGET=${TPU_RETRY_SLOW_BUDGET:-6}   # attempts that burned a real claim
 cd /root/repo
 slow=0
+TMP=$(mktemp)
+trap 'rm -f "$TMP"' EXIT
 for i in $(seq 1 "$ATTEMPTS"); do
   echo "=== r4 session attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
   t0=$(date +%s)
-  timeout 7200 python -u scripts/tpu_r4_session.py >> "$LOG" 2>&1
-  rc=$?
+  : > "$TMP"
+  # tee keeps $LOG streaming live (a killed loop still leaves diagnostics)
+  # while $TMP holds this attempt's output for the claimed-marker check
+  timeout 7200 python -u scripts/tpu_r4_session.py 2>&1 | tee -a "$LOG" > "$TMP"
+  rc=${PIPESTATUS[0]}
   dur=$(( $(date +%s) - t0 ))
   echo "=== attempt $i rc=$rc dur=${dur}s $(date -u +%H:%M:%S) ===" >> "$LOG"
   if [ "$rc" = "0" ]; then exit 0; fi
-  # a long failed attempt likely claimed the chip and wedged mid-session;
-  # those burn real claim budget and get a separate, smaller cap
-  if [ "$dur" -gt 900 ]; then
+  # only attempts that actually CLAIMED the chip and then failed burn real
+  # claim budget (a claim-stage hang, however long, held nothing); those
+  # get a separate, smaller cap
+  if grep -q "tpu_r4_session: claimed" "$TMP"; then
     slow=$((slow + 1))
     if [ "$slow" -ge "$SLOW_BUDGET" ]; then
-      echo "=== r4 session: $slow slow failures, stopping $(date -u +%H:%M:%S) ===" >> "$LOG"
+      echo "=== r4 session: $slow claimed-then-failed attempts, stopping $(date -u +%H:%M:%S) ===" >> "$LOG"
       exit 2
     fi
   fi

@@ -37,6 +37,22 @@ def test_jnp_slice_embed_match_host():
             np.testing.assert_array_equal(back_h[k], np.asarray(back_d[k]), err_msg=k)
 
 
+def test_bucket_pow2_bounds_compile_space():
+    from heterofl_tpu.parallel.grouped import _bucket_pow2
+
+    assert [_bucket_pow2(n) for n in (1, 2, 3, 4, 5, 8, 9)] == [1, 2, 4, 4, 8, 8, 16]
+    # the per-level program cache keys on (rate, bucketed slots): across any
+    # count sequence 1..A the distinct keys per level are O(log A), which is
+    # the whole point of bucketing (a per-round pattern key would be the
+    # cross-product)
+    A = 100
+    n_dev = 8
+    from heterofl_tpu.parallel.round_engine import _ceil_div
+
+    keys = {_bucket_pow2(_ceil_div(c, n_dev)) * n_dev for c in range(1, A + 1)}
+    assert len(keys) <= 5, keys  # log2(100/8) + 1
+
+
 def _run_pair(n_clients, n_data, user_idx, control="1_8_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1"):
     cfg, ds, data = _vision_setup(control=control)
     model = make_model(cfg)
