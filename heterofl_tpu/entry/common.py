@@ -33,7 +33,7 @@ from ..data import (
     stack_client_shards,
     stack_client_token_rows,
 )
-from ..fed.core import round_rates
+from ..fed.core import round_rates, validate_width_geometry
 from ..models import make_model
 from ..parallel import RoundEngine, make_mesh
 from ..parallel.evaluation import Evaluator
@@ -141,6 +141,7 @@ class FedExperiment:
         cfg = self.cfg
         _maybe_compute_norm_stats(cfg, self.dataset)
         self.model = make_model(cfg)
+        validate_width_geometry(self.model, cfg)
         n_data = max(1, cfg["mesh"].get("data", 1))
         n_clients = cfg["mesh"].get("clients", 0) or None
         try:

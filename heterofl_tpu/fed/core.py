@@ -60,6 +60,29 @@ def sample_model_rates(key: jax.Array, cfg: Dict[str, Any],
     raise ValueError("Not valid model split mode")
 
 
+def validate_width_geometry(model: ModelDef, cfg: Dict[str, Any]) -> None:
+    """Reject width configs where the per-head q/k/v slice outruns the
+    prefix width slice (ref fed.py:115-131 couples the two; when
+    ``heads * ceil(head_dim * r) != ceil(size * r)`` at some level the
+    sub-model rows reference zeroed embedding dims -- the reference
+    silently degrades, here it would NaN).  Raises with the minimal fix."""
+    rates = {float(r) / cfg["global_model_rate"] for r in cfg["model_rate"]}
+    for name, g in model.groups.items():
+        if g.kind != "per_head":
+            continue
+        hd = g.size // g.num_heads
+        for wr in sorted(rates):
+            if g.num_heads * math.ceil(hd * wr) != math.ceil(g.size * wr):
+                raise ValueError(
+                    f"width geometry: group {name!r} (size {g.size}, "
+                    f"{g.num_heads} heads) is inconsistent at rate {wr:g}: "
+                    f"per-head slice keeps {g.num_heads * math.ceil(hd * wr)} "
+                    f"dims but the width slice keeps {math.ceil(g.size * wr)}; "
+                    f"pick embedding_size so embedding*rate is a multiple-safe "
+                    f"size (e.g. embedding_size*min_rate >= num_heads and "
+                    f"head_dim divisible by 1/min_rate)")
+
+
 ROUND_RATE_SALT = 7
 
 

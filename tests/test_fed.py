@@ -4,6 +4,7 @@ averaging, label-split restriction, stale-value fallback (ref fed.py:180-298).""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from heterofl_tpu import config as C
 from heterofl_tpu.fed import (
@@ -155,6 +156,30 @@ def test_non_a_global_mode_width_rates():
     assert int(m.groups["h1"].active_count(wr[0])) == 8
     # a 'c' client gets ceil(8*0.5)=4 channels, matching ceil(16*0.25)
     assert int(m.groups["h1"].active_count(wr[1])) == 4
+
+
+def test_validate_width_geometry():
+    """Per-head vs prefix slice consistency (ref fed.py:115-131): flagship
+    dims pass at every level; a 16-dim 2-head embedding breaks at rate 1/16
+    (the 16-device dryrun NaN, round 5) and must raise."""
+    from heterofl_tpu.fed.core import validate_width_geometry
+    from heterofl_tpu.models import make_model
+
+    from test_models import small_cfg
+
+    cfg = small_cfg("transformer", data_name="WikiText2",
+                    control="1_8_0.5_iid_fix_a1-b1-c1_none_1_1")
+    model = make_model(cfg)  # emb 32, 4 heads: consistent down to rate 1/4
+    validate_width_geometry(model, cfg)
+    cfg_bad = small_cfg("transformer", data_name="WikiText2",
+                        control="1_8_0.5_iid_fix_a1-e1_none_1_1")  # min rate 1/16
+    cfg_bad["transformer"] = {"embedding_size": 16, "num_heads": 2,
+                              "hidden_size": 32, "num_layers": 1, "dropout": 0.0}
+    bad = make_model(cfg_bad)
+    with pytest.raises(ValueError, match="width geometry"):
+        validate_width_geometry(bad, cfg_bad)
+    # vision models have no per-head groups: always fine
+    validate_width_geometry(make_model(small_cfg("conv")), small_cfg("conv"))
 
 
 def test_sample_model_rates_fix_and_dynamic():
