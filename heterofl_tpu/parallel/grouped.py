@@ -25,6 +25,20 @@ two, so the compile space is O(levels x log A) -- NOT the cross-product of
 per-level counts (a per-round-pattern mega-program would recompile
 combinatorially as the sampled mix varies round to round).
 
+Two level placements (``cfg['level_placement']``): ``span`` (default) runs
+every level across the whole clients axis back-to-back; ``slices``
+partitions the clients-axis device rows among the levels in proportion to
+their EXPECTED FLOP share (static per experiment: fix-mode per-level user
+counts, dynamic-mode proportions) and dispatches each level's program to
+its own disjoint sub-mesh -- the programs then overlap in time (async
+dispatch), which is the pod-regime layout the MEASUREMENTS.md roofline
+prescribes (params are ICI-broadcast to each slice and the level partials
+brought back to the full mesh for the combine).  Static allocation keeps
+the compile space at O(levels x log A) and the cache keys bound to fixed
+device ranges; per-round count fluctuation is absorbed by slot bucketing
+inside each slice.  Multi-process meshes fall back to ``span`` (slice
+boundaries are not yet host-aligned).
+
 Client PRNG keys are ``fold_in(key, 13 + global_uid)`` -- the masked
 engine's convention -- so with the same inputs both engines produce the same
 new global parameters (tests/test_grouped.py) up to float association.
@@ -42,7 +56,7 @@ from typing import Any, Dict, List, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..fed.core import combine_counted, embed_sliced_jnp, extract_sliced_jnp
 from ..models import make_model
@@ -71,6 +85,16 @@ class GroupedRoundEngine:
                              "use the masked engine for sharded placement")
         self.cfg = cfg
         self.mesh = mesh
+        # 'span' (default): every level's slots spread over the whole
+        # clients axis, levels run back-to-back.  'slices': the clients-axis
+        # device rows are partitioned among the levels in proportion to
+        # FLOP share, each level's dense program runs on its own sub-mesh
+        # and the programs execute CONCURRENTLY (async dispatch to disjoint
+        # devices) -- the pod-regime layout of the MEASUREMENTS.md roofline.
+        # Falls back to 'span' when there are fewer device rows than levels.
+        self.level_placement = cfg.get("level_placement", "span")
+        if self.level_placement not in ("span", "slices"):
+            raise ValueError(f"Not valid level_placement: {self.level_placement!r}")
         self.global_rate = cfg["global_model_rate"]
         self.global_model = make_model(cfg)
         self.is_lm = self.global_model.meta.get("kind") == "transformer"
@@ -79,22 +103,79 @@ class GroupedRoundEngine:
         for rate in sorted({float(r) for r in cfg["model_rate"]}, reverse=True):
             model = make_model(cfg, model_rate=rate)
             self.levels[rate] = (model, RoundEngine(model, cfg, mesh=None))
-        self._level_progs: Dict[Tuple[float, int], Any] = {}
+        self._level_progs: Dict[Tuple, Any] = {}
         self._combine_progs: Dict[int, Any] = {}
+        self._slices: Dict[float, Tuple[int, int]] = {}
+        self._submeshes: Dict[Tuple[int, int], Any] = {}
+        if self.level_placement == "slices":
+            if jax.process_count() > 1:
+                # slice boundaries are not host-aligned yet: a level whose
+                # rows all belong to another process would wedge multi-
+                # controller dispatch -- fall back to span until verified
+                import warnings
+
+                warnings.warn("level_placement='slices' is single-process "
+                              "only for now; falling back to 'span'")
+                self.level_placement = "span"
+            else:
+                self._slices = self._static_mesh_slices()
+                if not self._slices:
+                    self.level_placement = "span"
+
+    def _static_mesh_slices(self) -> Dict[float, Tuple[int, int]]:
+        """Allocate clients-axis device rows to levels once per experiment,
+        in proportion to EXPECTED FLOP share: fix mode weights each level by
+        its user count, dynamic mode by its sampling proportion, both times
+        width_rate^2 (conv/matmul FLOPs scale ~rate^2).  Static allocation
+        keeps program cache keys bound to fixed (lo, hi) device ranges --
+        per-round count fluctuation is absorbed by slot bucketing inside
+        each slice.  Empty dict when rows < levels (span fallback)."""
+        cfg = self.cfg
+        C = self.mesh.shape["clients"]
+        level_rates = sorted(self.levels, reverse=True)
+        if C < len(level_rates) or len(level_rates) <= 1:
+            return {}
+        if cfg["model_split_mode"] == "fix":
+            vec = np.asarray(cfg["model_rate"], np.float64)
+            weights = [float((vec == r).sum()) for r in level_rates]
+        else:
+            weights = [float(p) for p in cfg["proportion"]]
+            # cfg['model_rate'] lists the level table in dynamic mode, in
+            # the same order as cfg['proportion']
+            order = {float(r): i for i, r in enumerate(cfg["model_rate"])}
+            weights = [weights[order[r]] for r in level_rates]
+        shares = np.array([w * (r / self.global_rate) ** 2
+                           for w, r in zip(weights, level_rates)], np.float64)
+        shares = np.maximum(shares, 1e-9)
+        rows = np.maximum(1, np.floor(shares / shares.sum() * C)).astype(int)
+        while rows.sum() > C:  # the >=1 floor can overshoot with many levels
+            cand = int(np.argmax(np.where(rows > 1, rows, -1)))
+            rows[cand] -= 1
+        while rows.sum() < C:  # leftovers go to the most loaded level
+            rows[int(np.argmax(shares / rows))] += 1
+        out, lo = {}, 0
+        for r, n in zip(level_rates, rows):
+            out[r] = (lo, lo + int(n))
+            lo += int(n)
+        return out
 
     # -- per-level program ---------------------------------------------
 
-    def _level_prog(self, rate: float, slots: int):
+    def _level_prog(self, rate: float, slots: int, sub_mesh=None,
+                    slice_range=None):
         """Jitted shard_map for one (rate level, slot count): dense local
         training of ``slots`` clients (sharded over the clients axis) and the
-        level's counted-sum partial, embedded to global shape."""
-        key_ = (rate, slots)
+        level's counted-sum partial, embedded to global shape.  With
+        ``sub_mesh`` the program spans only that fixed device slice
+        (level_placement='slices'; ``slice_range`` is its (lo, hi) row range
+        and keys the cache so a program can never run on a stale slice)."""
+        mesh = sub_mesh if sub_mesh is not None else self.mesh
+        key_ = (rate, slots, slice_range)
         if key_ in self._level_progs:
             return self._level_progs[key_]
         gm = self.global_model
         model_l, eng_l = self.levels[rate]
         wr = rate / self.global_rate  # static for this program
-        mesh = self.mesh
         n_data = mesh.shape["data"]
         data_axis = "data" if n_data > 1 else None
 
@@ -182,21 +263,44 @@ class GroupedRoundEngine:
         by_level: Dict[float, List[int]] = {}
         for pos, r in enumerate(rates):
             by_level.setdefault(float(r), []).append(pos)
+        level_order = sorted(by_level, reverse=True)
 
+        sliced_mode = self.level_placement == "slices"
         args = tuple(jnp.asarray(a) for a in data)
         lr = jnp.asarray(lr, jnp.float32)
+        full_rep = NamedSharding(self.mesh, P())
         sums, cnts, ms_levels, positions = [], [], [], []
-        for rate in sorted(by_level, reverse=True):
+        for rate in level_order:
             pos = by_level[rate]
-            slots = _bucket_pow2(_ceil_div(len(pos), n_dev)) * n_dev
+            if sliced_mode:
+                lo, hi = self._slices[rate]
+                sub = self._submeshes.setdefault(
+                    (lo, hi), Mesh(self.mesh.devices[lo:hi], ("clients", "data")))
+                n_dev_l = hi - lo
+                # params replicated onto this level's fixed slice (ICI
+                # broadcast); dispatches to disjoint devices overlap in time
+                p_in = jax.device_put(global_params, NamedSharding(sub, P()))
+                srange = (lo, hi)
+            else:
+                sub, n_dev_l, p_in, srange = None, n_dev, global_params, None
+            slots = _bucket_pow2(_ceil_div(len(pos), n_dev_l)) * n_dev_l
             u = -np.ones(slots, np.int32)
             u[: len(pos)] = user_idx[pos]
-            sum_l, cnt_l, ms = self._level_prog(rate, slots)(
-                global_params, key, lr, jnp.asarray(u), *args)
+            sum_l, cnt_l, ms = self._level_prog(rate, slots, sub, srange)(
+                p_in, key, lr, jnp.asarray(u), *args)
+            if sliced_mode:
+                # bring the level partials back onto the full mesh so the
+                # combine program sees co-located inputs
+                sum_l = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, full_rep), sum_l)
+                cnt_l = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, full_rep), cnt_l)
             sums.append(sum_l)
             cnts.append(cnt_l)
             ms_levels.append(ms)
             positions.append(pos)
+        if sliced_mode:
+            global_params = jax.device_put(global_params, full_rep)
         new_params = self._combine_prog(len(sums))(global_params, sums, cnts)
 
         n_slots = len(user_idx)

@@ -134,6 +134,53 @@ def test_grouped_lm_matches_masked():
 
 
 @pytest.mark.slow
+def test_level_slices_placement_matches_span():
+    """level_placement='slices': each level's dense program runs on its own
+    FLOP-share-proportional slice of the clients axis (concurrent dispatch
+    to disjoint devices -- the pod layout of the roofline).  Same round
+    result as the default span placement."""
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    user_idx = np.array([0, 2, 4, 6, 1, 3], np.int32)
+    rates = np.asarray(cfg["model_rate"], np.float32)[user_idx]
+    key, lr = jax.random.key(5), 0.05
+
+    span = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    new_a, ms_a = span.train_round(model.init(jax.random.key(0)), user_idx, rates,
+                                   data, lr, key)
+    sl = GroupedRoundEngine(dict(cfg, level_placement="slices"), make_mesh(8, 1))
+    new_b, ms_b = sl.train_round(model.init(jax.random.key(0)), user_idx, rates,
+                                 data, lr, key)
+    np.testing.assert_allclose(ms_a["n"], ms_b["n"], rtol=0)
+    for k in new_a:
+        np.testing.assert_allclose(np.asarray(new_a[k]), np.asarray(new_b[k]),
+                                   rtol=5e-4, atol=5e-5, err_msg=k)
+
+
+def test_mesh_slices_partition():
+    """Static row allocation: proportional to expected count x rate^2,
+    >=1 row per level, exactly covers the axis, span fallback when
+    rows < levels."""
+    cfg, ds, data = _vision_setup()  # 5 levels over 8 users
+    grp = GroupedRoundEngine(dict(cfg, level_placement="slices"), make_mesh(8, 1))
+    assert grp.level_placement == "slices"
+    sl = grp._slices
+    level_rates = sorted(sl, reverse=True)
+    widths = [sl[r][1] - sl[r][0] for r in level_rates]
+    assert all(w >= 1 for w in widths) and sum(widths) == 8
+    assert widths[0] == max(widths)  # full-width level owns the most rows
+    # contiguous non-overlapping cover of [0, 8)
+    lo = 0
+    for r in level_rates:
+        assert sl[r][0] == lo
+        lo = sl[r][1]
+    assert lo == 8
+    # fewer rows than levels: constructor falls back to span
+    grp2 = GroupedRoundEngine(dict(cfg, level_placement="slices"), make_mesh(2, 1))
+    assert grp2.level_placement == "span"
+
+
+@pytest.mark.slow
 def test_grouped_failure_injection_matches_masked():
     """client_failure_rate: the grouped engine derives the alive set from
     the same fold_in(key, 98) stream as the masked engine, so with the same
