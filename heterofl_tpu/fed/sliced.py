@@ -12,6 +12,11 @@ aggregation happens host-side via gather/scatter + counted averaging.
 Uses: host/CPU debugging, memory-constrained execution, and the round-level
 equivalence check against the masked engine (tests/test_sliced.py) -- with
 the same PRNG keys both strategies produce the same new global parameters.
+
+NOTE: this is the host-orchestrated DEBUG twin (measured ~30x slower than
+the masked engine).  The production dense-per-level path is the mesh-native
+``parallel/grouped.py`` (``strategy: grouped``), which keeps the whole
+round on device.
 """
 
 from __future__ import annotations

@@ -180,6 +180,16 @@ def test_mesh_slices_partition():
     assert grp2.level_placement == "span"
 
 
+def test_grouped_slices_multiprocess_fallback(monkeypatch):
+    """Slice boundaries are not host-aligned yet: multi-controller runs
+    must fall back to span with a warning, not wedge dispatch."""
+    cfg, ds, data = _vision_setup()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.warns(UserWarning, match="single-process"):
+        g = GroupedRoundEngine(dict(cfg, level_placement="slices"), make_mesh(8, 1))
+    assert g.level_placement == "span" and not g._slices
+
+
 @pytest.mark.slow
 def test_grouped_failure_injection_matches_masked():
     """client_failure_rate: the grouped engine derives the alive set from
