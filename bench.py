@@ -41,24 +41,30 @@ import time
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def _cache_dir():
-    """Persistent compile cache: round-2 measured 16-21s compiles; a warm
-    cache under the repo survives across bench runs/rounds and shrinks the
-    window in which a wedged tunnel can eat the whole TPU budget.
+_CACHE_DIR = None
 
-    The dir is fingerprinted by the host CPU's feature flags: XLA:CPU AOT
-    entries embed machine features, and loading a cache written on a
-    different host risks SIGILL mid-bench (observed: `cpu_aot_loader.cc`
-    feature-mismatch errors when this box was reprovisioned between rounds).
-    """
-    import hashlib
-    try:
-        with open("/proc/cpuinfo") as f:
-            flags = next((l for l in f if l.startswith("flags")), "")
-    except OSError:
-        flags = ""
-    fp = hashlib.sha1(flags.encode()).hexdigest()[:8]
-    return os.path.join(_REPO, ".jax_cache", fp)
+
+def _cache_dir():
+    """Persistent compile cache: round-2 measured 16-21s compiles (40.3s for
+    the flagship program, BENCH_r05); a warm cache under the repo survives
+    across bench runs/rounds and shrinks the window in which a wedged tunnel
+    can eat the whole TPU budget.  Shared with the fed drivers and the tier-1
+    test gate via heterofl_tpu/utils/compile_cache.py (CPU-feature-
+    fingerprinted dir -- see that module for the SIGILL rationale).  Loaded
+    by FILE PATH, not via the package: the supervisor must stay jax-free
+    (importing heterofl_tpu.utils pulls jax through checkpoint.py, adding a
+    multi-second import and a failure surface to the must-not-fail path)."""
+    global _CACHE_DIR
+    if _CACHE_DIR is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_heterofl_compile_cache",
+            os.path.join(_REPO, "heterofl_tpu", "utils", "compile_cache.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)  # imports hashlib/os/sys only
+        _CACHE_DIR = mod.default_cache_dir(_REPO)
+    return _CACHE_DIR
 
 
 def _force_cpu():
@@ -117,6 +123,9 @@ def _supervise() -> int:
         # parsed:null failure mode all over again.
         env = dict(os.environ)
         env.update(extra_env)
+        # children inherit the warm compile cache (the supervisor setdefaults
+        # it above; this keeps the wiring explicit for operator env overrides)
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir())
         p = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                              env=env, stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE, text=True,
@@ -240,7 +249,8 @@ def main():
     from heterofl_tpu import config as C
     from heterofl_tpu.data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
     from heterofl_tpu.models import make_model
-    from heterofl_tpu.parallel import RoundEngine, make_mesh
+    from heterofl_tpu.parallel import (MetricsPipeline, PendingMetrics, PhaseTimer,
+                                       RoundEngine, make_mesh)
 
     hb("claiming devices")
     devs = jax.devices()  # first touch claims the tunnel -- the wedge point
@@ -312,55 +322,99 @@ def main():
     hb(f"data staged + engine built (strategy {strategy})")
 
     n_active = int(np.ceil(cfg["frac"] * users))
+    # stage/dispatch/compute/fetch attribution for every timed round, plus
+    # BENCH_FETCH_EVERY>1 to pipeline the D2H metric fetch behind the next
+    # round's dispatch (parallel/staging.py; default 1 = synchronous parity)
+    timer = PhaseTimer()
+    try:
+        # clamp to >=1 so the emitted fetch_every matches what the pipeline
+        # actually does (MetricsPipeline clamps internally too)
+        fetch_every = max(1, int(os.environ.get("BENCH_FETCH_EVERY") or 1))
+    except ValueError:
+        print(f"bench: ignoring malformed "
+              f"BENCH_FETCH_EVERY={os.environ['BENCH_FETCH_EVERY']!r}",
+              file=sys.stderr)
+        fetch_every = 1
+    pipe = MetricsPipeline(fetch_every)
+
     def round_once(params, r):
         user_idx = rng.permutation(users)[:n_active].astype(np.int32)
         if strategy == "grouped":
-            params, ms = engine.train_round(params, user_idx, rates_vec[user_idx],
-                                            data, 0.1, jax.random.key(r))
+            params, pending = engine.train_round(params, user_idx, rates_vec[user_idx],
+                                                 data, 0.1, jax.random.key(r),
+                                                 timer=timer, async_metrics=True)
         else:
-            params, ms = engine.train_round(params, jax.random.key(r), 0.1, user_idx, data)
-        return params, ms
+            params, ms = engine.train_round(params, jax.random.key(r), 0.1, user_idx,
+                                            data, timer=timer)
+            pending = PendingMetrics(ms)
+        return params, pending
 
-    def emit(rps, dt, compile_s, ms, rounds_done):
+    def emit(rps, dt, compile_s, ms, ms_round, rounds_done, rtimes):
         # a degraded (non-flagship-volume / wrong-platform) run must not
         # pretend to be comparable to the 10 rps north star (VERDICT r4
-        # item 5): vs_baseline is null unless this is the real program
+        # item 5): vs_baseline is null unless this is the real program.
+        # With BENCH_FETCH_EVERY>1 the loss lags the timed round by up to K
+        # rounds; final_loss_round marks which round it belongs to so a
+        # mid-run kill's salvaged line is not silently stale.
         loss = float(np.asarray(ms["loss_sum"]).sum() / np.asarray(ms["n"]).sum())
         print(json.dumps({
             "metric": "federated_rounds_per_sec_cifar10_resnet18_a1-e1_100c",
             "value": round(rps, 4),
             "unit": "rounds/sec",
             "vs_baseline": None if degraded else round(rps / 10.0, 4),
-            "extra": {"round_sec": round(dt, 3), "compile_sec": round(compile_s, 1),
+            "extra": {"round_sec": round(dt, 3),
+                      # both statistics for BOTH strategies (ADVICE r5 item 1):
+                      # 'value' keeps its documented per-strategy semantics, but
+                      # cross-strategy comparisons should use like-for-like
+                      "round_sec_avg": round(sum(rtimes) / len(rtimes), 3),
+                      "round_sec_best": round(min(rtimes), 3),
+                      "phases": {k: round(v, 3)
+                                 for k, v in sorted(timer.delta(phases_warm).items())},
+                      "compile_sec": round(compile_s, 1),
                       "devices": len(devs), "platform": platform,
                       "active_clients": n_active, "users": users,
                       "n_train": n_train, "final_loss": round(loss, 4),
                       "rounds_timed": rounds_done, "strategy": strategy,
+                      **({"fetch_every": fetch_every,
+                          "final_loss_round": ms_round} if fetch_every != 1 else {}),
                       **({"degraded": degraded} if degraded else {})},
         }), flush=True)
 
     # compile + warmup
     hb("compiling (warmup round)")
     t0 = time.time()
-    params, ms = round_once(params, 0)
+    params, pending = round_once(params, 0)
     jax.block_until_ready(params)
+    last_ms, last_ms_round = pending.fetch(), 0  # warmup metrics, synchronous
     compile_s = time.time() - t0
+    # phases are reported RELATIVE to this snapshot so the breakdown shows
+    # steady-state cost, not the warmup compile baked into 'dispatch'
+    phases_warm = timer.snapshot()
     hb(f"compile done ({compile_s:.1f}s incl. warmup round)")
     # timed; a refined JSON line lands after EVERY round so a mid-run kill
     # still leaves the supervisor a real measurement to forward.  The
     # grouped strategy compiles per-level programs per slot-count bucket, so
-    # a timed round can hit a fresh-bucket compile; its statistic is the
-    # BEST (steady-state) round, the masked engine's the running average.
+    # a timed round can hit a fresh-bucket compile; its 'value' statistic is
+    # the BEST (steady-state) round, the masked engine's the running average
+    # -- extra.round_sec_avg/_best carry both for either strategy.
     rtimes = []
     for r in range(1, timed_rounds + 1):
         t0 = time.time()
-        params, ms = round_once(params, r)
-        jax.block_until_ready(params)
+        params, pending = round_once(params, r)
+        with timer.phase("compute"):
+            jax.block_until_ready(params)
         rtimes.append(time.time() - t0)
+        with timer.phase("fetch"):
+            due = pipe.push(r, pending)
+        if due:
+            last_ms_round, last_ms = due[-1]
         dt = min(rtimes) if strategy == "grouped" else sum(rtimes) / len(rtimes)
         hb(f"round {r}/{timed_rounds} done ({dt:.2f}s/round "
            f"{'best' if strategy == 'grouped' else 'avg'})")
-        emit(1.0 / dt, dt, compile_s, ms, r)
+        emit(1.0 / dt, dt, compile_s, last_ms, last_ms_round, r, rtimes)
+    due = pipe.flush()
+    if due:  # deferred-fetch tail: re-emit with the final round's loss
+        emit(1.0 / dt, dt, compile_s, due[-1][1], due[-1][0], timed_rounds, rtimes)
 
 
 if __name__ == "__main__":

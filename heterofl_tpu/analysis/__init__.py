@@ -1,3 +1,12 @@
 """Post-hoc analysis tooling: model profiler, experiment grid generator,
 result aggregation/plots (the reference's ``summary.py`` / ``make.py`` /
 ``process.py`` layer)."""
+
+
+def cost_analysis_dict(compiled):
+    """Normalise ``compiled.cost_analysis()`` across jax versions: newer
+    jax returns the properties dict directly, older versions wrap it in a
+    one-element list/tuple.  The one shim for every FLOP account (summary
+    profiler, scripts/grouped_flops.py, tests/test_grouped.py)."""
+    ca = compiled.cost_analysis()
+    return ca[0] if isinstance(ca, (list, tuple)) else ca

@@ -53,10 +53,9 @@ def profile_model(cfg: Dict[str, Any], model_rate: float, batch_size: Optional[i
 
     flops, flops_error = float("nan"), None
     try:
-        compiled = jax.jit(fwd).lower(params, batch).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
+        from . import cost_analysis_dict
+
+        ca = cost_analysis_dict(jax.jit(fwd).lower(params, batch).compile())
         flops = float(ca.get("flops", float("nan")))
     except Exception as e:  # pragma: no cover - cost analysis availability varies
         flops_error = f"{type(e).__name__}: {e}"

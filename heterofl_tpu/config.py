@@ -113,6 +113,13 @@ DEFAULT_CFG: Dict[str, Any] = {
     "synthetic": False,  # force synthetic data (offline/testing)
     "client_failure_rate": 0.0,  # per-round client crash probability (fault injection)
     "eval_interval": 1,  # rounds between sBN+eval passes (1 = reference parity)
+    # async round pipelining: per-round train-metric sums stay on device and
+    # are fetched every K rounds (parallel/staging.py MetricsPipeline), so
+    # round t+1 dispatches while round t's sums transfer; eval boundaries
+    # flush.  1 = synchronous fetch (reference parity).  K>1 logs train
+    # metrics in K-round batches and a mid-batch checkpoint omits the not-
+    # yet-fetched rounds from logger history (a perf knob, not a semantics one).
+    "metrics_fetch_every": 1,
     "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
     # Applied LAST by process_control: per-key overrides of any derived field

@@ -35,8 +35,9 @@ from ..data import (
 )
 from ..fed.core import round_rates, validate_width_geometry
 from ..models import make_model
-from ..parallel import RoundEngine, make_mesh
+from ..parallel import MetricsPipeline, PendingMetrics, PhaseTimer, RoundEngine, make_mesh
 from ..parallel.evaluation import Evaluator
+from ..utils.compile_cache import enable_persistent_cache
 from ..utils import (
     Logger,
     checkpoint_path,
@@ -154,6 +155,21 @@ class FedExperiment:
         self.num_active = int(np.ceil(cfg["frac"] * cfg["num_users"]))
         self._round_times: List[float] = []  # steady-state round durations (ETA)
         self._first_round_done = False
+        # staging/dispatch telemetry + async metric fetch (parallel/staging.py):
+        # per-round metric sums stay on device and are drained every
+        # cfg['metrics_fetch_every'] rounds (eval boundaries flush)
+        self.phase_timer = PhaseTimer()
+        self.metrics_pipe = MetricsPipeline(int(cfg.get("metrics_fetch_every", 1) or 1))
+        eval_iv = max(1, int(cfg.get("eval_interval", 1) or 1))
+        if self.metrics_pipe.fetch_every > eval_iv:
+            import warnings
+
+            # evaluate() drains the pipeline, so batches never grow past the
+            # eval interval -- say so instead of silently under-delivering
+            warnings.warn(
+                f"metrics_fetch_every={self.metrics_pipe.fetch_every} exceeds "
+                f"eval_interval={eval_iv}: each eval boundary flushes the metric "
+                f"pipeline, so the effective fetch batch is eval_interval rounds")
         if cfg.get("strategy", "masked") not in ("masked", "sliced", "grouped"):
             raise ValueError(f"Not valid strategy: {cfg.get('strategy')!r}")
         self.alt_engine = None
@@ -225,6 +241,7 @@ class FedExperiment:
         user_idx = self.sample_users()
         key = jax.random.fold_in(self.host_key, epoch)
         t0 = time.time()
+        phases0 = self.phase_timer.snapshot()
         # first steady-state round actually executed (works under resume too)
         profiling = (self.cfg.get("profile_dir") and self._first_round_done
                      and not getattr(self, "_profiled", False))
@@ -234,44 +251,78 @@ class FedExperiment:
         if self.alt_engine is not None:
             rates = np.asarray(round_rates(key, self.cfg, jnp.asarray(user_idx)))
             if self.cfg.get("strategy") == "grouped":
-                # mesh-native: params stay on device end to end
-                params, ms = self.alt_engine.train_round(
-                    params, user_idx, rates, self.train_data, lr, key)
+                # mesh-native: params stay on device end to end; the metric
+                # sums stay there too until the pipeline drains them
+                params, pending = self.alt_engine.train_round(
+                    params, user_idx, rates, self.train_data, lr, key,
+                    timer=self.phase_timer, async_metrics=True)
             else:
                 new_np, ms = self.alt_engine.train_round(
                     {k: np.asarray(v) for k, v in params.items()}, user_idx, rates,
                     self.train_data, lr, key)
                 params = {k: jnp.asarray(v) for k, v in new_np.items()}
+                pending = PendingMetrics(ms)
         else:
-            params, ms = self.engine.train_round(params, key, lr, user_idx, self.train_data)
-            ms = {k: np.asarray(v) for k, v in ms.items()}
+            params, ms = self.engine.train_round(params, key, lr, user_idx,
+                                                 self.train_data, timer=self.phase_timer)
+            pending = PendingMetrics(ms)
         if profiling:
             jax.block_until_ready(params)
             jax.profiler.stop_trace()
-        named = summarize_sums(ms, self.cfg["model_name"])
-        logger.append(named, "train", n=float(ms["n"].sum()))
-        # running ETA over steady-state rounds, parity with the reference's
-        # telemetry (train_classifier_fed.py:105-119); the first processed
-        # round (compile) is excluded from the mean
-        dt = time.time() - t0
+        tag = {"epoch": epoch, "lr": lr, "dt": 0.0, "phases": {}}
+        with self.phase_timer.phase("fetch"):
+            due = self.metrics_pipe.push(tag, pending)
+        # dt and the phase breakdown are filled in AFTER the push (the tag is
+        # the same dict object the pipeline holds, so deferred entries carry
+        # their own round's values): at the parity default
+        # (metrics_fetch_every=1) the push fetches synchronously, so dt spans
+        # dispatch + device compute exactly like the pre-staging driver and
+        # the round's own fetch shows up in ITS phases line; with K>1 the
+        # non-fetching rounds record their (tiny) dispatch wall and the
+        # batch-fetching round absorbs the whole batch's compute + drain, so
+        # the ETA mean over rounds stays the true cadence.  First processed
+        # round (compile) is excluded, parity with the reference's telemetry
+        # (train_classifier_fed.py:105-119).
+        tag["dt"] = dt = time.time() - t0
+        tag["phases"] = self.phase_timer.delta(phases0)
         if self._first_round_done:
             self._round_times.append(dt)
         else:
             self._first_round_done = True  # exclude the compile round
+        for tag0, ms_host in due:
+            self._log_train_round(logger, tag0["epoch"], tag0["lr"], tag0["dt"],
+                                  tag0["phases"], ms_host)
+        return params
+
+    def _log_train_round(self, logger: Logger, epoch: int, lr: float, dt: float,
+                         phases: Dict[str, float], ms: Dict[str, np.ndarray]):
+        """Log one (possibly deferred) round's train metrics + info lines."""
+        named = summarize_sums(ms, self.cfg["model_name"])
+        logger.append(named, "train", n=float(ms["n"].sum()))
         mean_dt = float(np.mean(self._round_times)) if self._round_times else dt
         remain = self.cfg["num_epochs"]["global"] - epoch
         eta = datetime.timedelta(seconds=round(mean_dt * remain))
+        breakdown = " ".join(f"{k} {v:.3f}s" for k, v in sorted(phases.items()))
         info = {"info": [f"Model: {self.tag}",
                          f"Train Epoch: {epoch}",
                          f"Learning rate: {lr:g}",
                          f"Rates: {sorted(set(ms['rate'][ms['n'] > 0].tolist()))}",
                          f"Round time: {dt:.2f}s",
+                         f"Round phases: {breakdown}" if breakdown else "Round phases: n/a",
                          f"Experiment Finished Time: {eta}"]}
         logger.append(info, "train", mean=False)
         logger.write("train", list(named))
-        return params
+
+    def _drain_metrics(self, logger: Logger):
+        """Flush the async metric pipeline (checkpoint/eval boundaries)."""
+        with self.phase_timer.phase("fetch"):
+            due = self.metrics_pipe.flush()
+        for tag, ms_host in due:
+            self._log_train_round(logger, tag["epoch"], tag["lr"], tag["dt"],
+                                  tag["phases"], ms_host)
 
     def evaluate(self, params, epoch: int, logger: Logger, label_split) -> Dict[str, float]:
+        self._drain_metrics(logger)  # eval boundary: fetch any deferred rounds
         cfg = self.cfg
         bn = {}
         if self.kind == "vision":
@@ -376,6 +427,7 @@ class FedExperiment:
                 if is_best:
                     copy_best(cfg["output_dir"], self.tag)
             logger.reset()
+        self._drain_metrics(logger)  # safety: nothing stays on device at exit
         return {"params": params, "bn_state": getattr(self, "bn_state", {}),
                 "logger": logger, "data_split": data_split, "label_split": label_split}
 
@@ -387,6 +439,9 @@ def run_main(description: str, model_default: str, data_default: str,
     from ..parallel.mesh import initialize_distributed
 
     initialize_distributed()  # no-op single-host; joins the pod otherwise
+    # persistent XLA compilation cache: repeated experiments skip the ~40s
+    # flagship-round compile (BENCH_r05 compile_sec); operator env wins
+    enable_persistent_cache()
     parser = build_cli(description)
     args = parser.parse_args(argv)
     cfg = cfg_from_args(args)

@@ -4,7 +4,11 @@ Parity: ``src/train_classifier_fed.py`` -- per round: sample
 ``ceil(frac * num_users)`` users, heterogeneous local SGD, counted-average
 aggregation, sBN recalibration, Local+Global eval, MultiStep LR, checkpoint +
 best copy pivoted on Global-Accuracy.  The whole round is one XLA program
-(see parallel/round_engine.py).
+(see parallel/round_engine.py); steady-state rounds dispatch with zero
+implicit host->device transfers (parallel/staging.py), each info line
+carries the stage/dispatch/fetch phase breakdown, and
+``--metrics_fetch_every K`` keeps metric sums on device for K rounds so
+dispatch overlaps the fetch (eval boundaries flush).
 """
 
 from .common import run_main

@@ -2,7 +2,10 @@
 
 Parity: ``src/train_transformer_fed.py`` -- no sBN recalibration, global
 metrics only, pivot = minimised Global-Perplexity
-(ref train_transformer_fed.py:31-32, 90).
+(ref train_transformer_fed.py:31-32, 90).  Shares the staged zero-
+resharding dispatch path, per-round phase telemetry and
+``--metrics_fetch_every`` async metric fetch with the classifier driver
+(entry/common.py + parallel/staging.py).
 """
 
 from .common import run_main

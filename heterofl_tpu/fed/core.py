@@ -96,6 +96,31 @@ def round_rates(round_key: jax.Array, cfg: Dict[str, Any],
     return sample_model_rates(jax.random.fold_in(round_key, ROUND_RATE_SALT), cfg, user_idx)
 
 
+def snap_to_levels(rates, levels, rtol: float = 1e-5, atol: float = 1e-8) -> np.ndarray:
+    """Snap sampled absolute model rates onto an engine's level table.
+
+    Incoming rates round-trip through float32 (:func:`round_rates`) while
+    level tables are host floats; exact-equality lookups only work because
+    the stock ``MODEL_SPLIT_RATE`` table is dyadic.  Nearest-level matching
+    with an ``isclose`` guard makes any rate table either snap cleanly or
+    fail loudly AT STAGING -- a ``ValueError`` naming the offending rates --
+    instead of a ``KeyError`` mid-round (ADVICE r5 item 2)."""
+    table = np.asarray(sorted({float(r) for r in levels}, reverse=True), np.float64)
+    r = np.asarray(rates, np.float64).reshape(-1)
+    if r.size == 0:
+        return r
+    snapped = table[np.argmin(np.abs(r[:, None] - table[None, :]), axis=1)]
+    ok = np.isclose(r, snapped, rtol=rtol, atol=atol)
+    if not ok.all():
+        bad = sorted(set(np.round(r[~ok], 6).tolist()))
+        raise ValueError(
+            f"model rates {bad} are not in the engine's level table "
+            f"{table.tolist()}: every sampled rate must match a level built "
+            f"at engine construction (fix cfg['model_rate'] or the incoming "
+            f"rate stream)")
+    return snapped
+
+
 def to_width_rates(model_rates: jnp.ndarray, cfg: Dict[str, Any]) -> jnp.ndarray:
     """Absolute model rate -> width/scaler rate relative to the global model
     (``scaler_rate = model_rate / global_model_rate``, ref fed.py:46,

@@ -1,3 +1,5 @@
 from .grouped import GroupedRoundEngine  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from .round_engine import RoundEngine, shard_client_data  # noqa: F401
+from .staging import (MetricsPipeline, PendingMetrics, PhaseTimer,  # noqa: F401
+                      PlacementCache, SlotPacker)

@@ -19,7 +19,11 @@ This engine realises the roofline's "group clients by rate level" design:
     (counted average + stale rule, semantics = ref fed.py:180-298).
 
 All intermediates are device arrays: the host only *dispatches* the L+1
-programs per round; no parameter or data bytes move through it.  Programs
+programs per round; no parameter or data bytes move through it.  The
+staging layer (staging.py) makes that literal in steady state -- data
+stacks are committed to each level's (sub-)mesh once, slot packing reuses
+cached host buffers, and metric sums can stay on device until the caller
+fetches them (``async_metrics``).  Programs
 are cached per (rate, slot-count) with slot counts bucketed to powers of
 two, so the compile space is O(levels x log A) -- NOT the cross-product of
 per-level counts (a per-round-pattern mega-program would recompile
@@ -56,12 +60,13 @@ from typing import Any, Dict, List, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
-from ..fed.core import combine_counted, embed_sliced_jnp, extract_sliced_jnp
+from ..fed.core import combine_counted, embed_sliced_jnp, extract_sliced_jnp, snap_to_levels
 from ..models import make_model
 from ..models.spec import count_masks as make_count_masks
 from .round_engine import RoundEngine, _ceil_div, _shard_map
+from .staging import PendingMetrics, PhaseTimer, PlacementCache, SlotPacker
 
 
 def _bucket_pow2(n: int) -> int:
@@ -106,7 +111,12 @@ class GroupedRoundEngine:
         self._level_progs: Dict[Tuple, Any] = {}
         self._combine_progs: Dict[int, Any] = {}
         self._slices: Dict[float, Tuple[int, int]] = {}
-        self._submeshes: Dict[Tuple[int, int], Any] = {}
+        # staged placement (ISSUE 1 tentpole): data stacks (and in slices
+        # mode the per-level operands) are committed to their sub-meshes
+        # ONCE, keyed by the static (lo, hi) ranges -- steady-state rounds
+        # dispatch device-resident buffers with zero implicit resharding
+        self._staging = PlacementCache(mesh)
+        self._packer = SlotPacker()
         if self.level_placement == "slices":
             if jax.process_count() > 1:
                 # slice boundaries are not host-aligned yet: a level whose
@@ -232,7 +242,12 @@ class GroupedRoundEngine:
             in_specs=(P(), P(), P(), P("clients")) + data_specs,
             out_specs=(P(), P(), P("clients")),
         )
-        prog = jax.jit(fn)
+        # Donation: in slices mode the params arg is this level's PRIVATE
+        # broadcast copy (device_put per round in train_round), so donating
+        # it releases the buffers the moment the level program consumes them.
+        # In span mode the SAME global params feed every level program and
+        # the combine -- donation there would invalidate shared buffers.
+        prog = jax.jit(fn, donate_argnums=(0,) if sub_mesh is not None else ())
         self._level_progs[key_] = prog
         return prog
 
@@ -253,60 +268,94 @@ class GroupedRoundEngine:
     # -- host wrapper ---------------------------------------------------
 
     def train_round(self, global_params: Dict[str, Any], user_idx: np.ndarray,
-                    rates: np.ndarray, data: Tuple, lr: float, key):
+                    rates: np.ndarray, data: Tuple, lr: float, key,
+                    timer: PhaseTimer = None, async_metrics: bool = False):
         """One round.  ``data`` is the replicated stacked tuple the masked
         engine takes; ``rates`` are the active users' absolute rates (host
-        side, same PRNG stream as the masked engine's in-jit draw)."""
-        n_dev = self.mesh.shape["clients"]
-        user_idx = np.asarray(user_idx, np.int32)
-        rates = np.asarray(rates, np.float64)
-        by_level: Dict[float, List[int]] = {}
-        for pos, r in enumerate(rates):
-            by_level.setdefault(float(r), []).append(pos)
-        level_order = sorted(by_level, reverse=True)
+        side, same PRNG stream as the masked engine's in-jit draw).
 
-        sliced_mode = self.level_placement == "slices"
-        args = tuple(jnp.asarray(a) for a in data)
-        lr = jnp.asarray(lr, jnp.float32)
-        full_rep = NamedSharding(self.mesh, P())
+        Steady state moves zero host data: the data stacks (and in slices
+        mode every per-level operand) are committed to their (sub-)meshes
+        once by the :class:`~.staging.PlacementCache`; per-round values --
+        slot ids, the params broadcast -- use explicit ``device_put`` only.
+        ``timer`` accounts the stage/dispatch/fetch phases.  With
+        ``async_metrics=True`` the per-slot metric sums stay on device and a
+        :class:`~.staging.PendingMetrics` is returned in their place, so the
+        caller can overlap the D2H fetch with the next round's dispatch."""
+        timer = timer if timer is not None else PhaseTimer()
+        n_dev = self.mesh.shape["clients"]
+        with timer.phase("stage"):
+            user_idx = np.asarray(user_idx, np.int32)
+            # snap to the level table: float32-round-tripped or non-dyadic
+            # rates either match a level or raise here, at staging -- never
+            # a KeyError mid-round (ADVICE r5 item 2)
+            rates = snap_to_levels(rates, self.levels)
+            by_level: Dict[float, List[int]] = {}
+            for pos, r in enumerate(rates):
+                by_level.setdefault(float(r), []).append(pos)
+            level_order = sorted(by_level, reverse=True)
+            sliced_mode = self.level_placement == "slices"
+            lr_full = self._staging.scalar(lr)
+
         sums, cnts, ms_levels, positions = [], [], [], []
         for rate in level_order:
             pos = by_level[rate]
-            if sliced_mode:
-                lo, hi = self._slices[rate]
-                sub = self._submeshes.setdefault(
-                    (lo, hi), Mesh(self.mesh.devices[lo:hi], ("clients", "data")))
-                n_dev_l = hi - lo
-                # params replicated onto this level's fixed slice (ICI
-                # broadcast); dispatches to disjoint devices overlap in time
-                p_in = jax.device_put(global_params, NamedSharding(sub, P()))
-                srange = (lo, hi)
-            else:
-                sub, n_dev_l, p_in, srange = None, n_dev, global_params, None
-            slots = _bucket_pow2(_ceil_div(len(pos), n_dev_l)) * n_dev_l
-            u = -np.ones(slots, np.int32)
-            u[: len(pos)] = user_idx[pos]
-            sum_l, cnt_l, ms = self._level_prog(rate, slots, sub, srange)(
-                p_in, key, lr, jnp.asarray(u), *args)
-            if sliced_mode:
-                # bring the level partials back onto the full mesh so the
-                # combine program sees co-located inputs
-                sum_l = jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, full_rep), sum_l)
-                cnt_l = jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, full_rep), cnt_l)
+            with timer.phase("stage"):
+                if sliced_mode:
+                    srange = self._slices[rate]
+                    sub = self._staging.submesh(*srange)
+                    n_dev_l = srange[1] - srange[0]
+                    lr_l = self._staging.scalar(lr, srange)
+                    key_l = self._staging.put(key, srange)
+                else:
+                    sub, n_dev_l, srange = None, n_dev, None
+                    lr_l, key_l = lr_full, key
+                # the level's data stacks: committed to its (sub-)mesh once,
+                # keyed by the static (lo, hi) range; per-round lookups are
+                # identity hits returning device-resident buffers
+                args = self._staging.replicated("train_data", data, srange=srange)
+                slots = _bucket_pow2(_ceil_div(len(pos), n_dev_l)) * n_dev_l
+                u = self._packer.buffer((rate, slots), (slots,))
+                u[: len(pos)] = user_idx[pos]
+                uarr = self._staging.put(u, srange, P("clients"))
+            with timer.phase("dispatch"):
+                if sliced_mode:
+                    # params broadcast onto this level's fixed slice (jitted
+                    # ICI replicate-copy with PRIVATE buffers -- see
+                    # PlacementCache.broadcast); the level program donates the
+                    # copy, releasing it the moment it is consumed.
+                    # Dispatches to disjoint devices overlap in time.
+                    p_in = self._staging.broadcast(global_params, srange)
+                else:
+                    p_in = global_params
+                sum_l, cnt_l, ms = self._level_prog(rate, slots, sub, srange)(
+                    p_in, key_l, lr_l, uarr, *args)
+                if sliced_mode:
+                    # bring the level partials back onto the full mesh so the
+                    # combine program sees co-located inputs
+                    sum_l = self._staging.put(sum_l)
+                    cnt_l = self._staging.put(cnt_l)
             sums.append(sum_l)
             cnts.append(cnt_l)
             ms_levels.append(ms)
             positions.append(pos)
-        if sliced_mode:
-            global_params = jax.device_put(global_params, full_rep)
-        new_params = self._combine_prog(len(sums))(global_params, sums, cnts)
+        with timer.phase("dispatch"):
+            if sliced_mode:
+                global_params = self._staging.put(global_params)
+            new_params = self._combine_prog(len(sums))(global_params, sums, cnts)
 
         n_slots = len(user_idx)
-        metrics = {k: np.zeros(n_slots, np.float32)
-                   for k in ("loss_sum", "score_sum", "n", "rate")}
-        for pos, ms in zip(positions, ms_levels):
-            for k in metrics:
-                metrics[k][pos] = np.asarray(ms[k])[: len(pos)]
-        return new_params, metrics
+
+        def _assemble(host_levels):
+            metrics = {k: np.zeros(n_slots, np.float32)
+                       for k in ("loss_sum", "score_sum", "n", "rate")}
+            for pos, ms in zip(positions, host_levels):
+                for k in metrics:
+                    metrics[k][pos] = ms[k][: len(pos)]
+            return metrics
+
+        pending = PendingMetrics(ms_levels, assemble=_assemble)
+        if async_metrics:
+            return new_params, pending
+        with timer.phase("fetch"):
+            return new_params, pending.fetch()

@@ -38,6 +38,7 @@ except ImportError:  # pragma: no cover
 from ..data.datasets import DATASET_STATS
 from ..fed.core import combine_counted, round_rates
 from .ring_attention import ring_attention
+from .staging import PhaseTimer, PlacementCache, SlotPacker
 from ..models.base import ModelDef
 from ..models.spec import count_masks as make_count_masks, mask_params, param_mask
 from ..ops.augment import augment_cifar, normalize_image
@@ -106,6 +107,13 @@ class RoundEngine:
         self._sbn = None
         self._eval_users = None
         self._eval_global = None
+        # staged placement + cached slot packing (ISSUE 1): the data stacks
+        # are committed to the mesh once, the per-round slot arrays reuse
+        # preallocated host buffers, and every transfer on the round path is
+        # an explicit device_put.  mesh=None engines (the grouped engine's
+        # per-level sub-engines) never run train_round and skip staging.
+        self._staging = PlacementCache(mesh) if mesh is not None else None
+        self._packer = SlotPacker()
 
     # ------------------------------------------------------------------
     # per-client local training (pure; vmapped across clients)
@@ -372,7 +380,8 @@ class RoundEngine:
         )
         return jax.jit(fn, donate_argnums=(0,))
 
-    def train_round(self, params, key, lr, user_idx, data: Tuple[jnp.ndarray, ...]):
+    def train_round(self, params, key, lr, user_idx, data: Tuple[jnp.ndarray, ...],
+                    timer: PhaseTimer = None):
         """Run one communication round.
 
         ``user_idx``: int32 [A] active user ids.  ``data``: for vision
@@ -381,36 +390,47 @@ class RoundEngine:
         placement the per-user arrays must come from :func:`shard_client_data`
         (user axis padded to the clients-axis size and device-sharded); each
         client then trains on the device owning its shard -- no round moves
-        any client data.  Returns ``(new_params, per-client metric sums)``.
+        any client data.  Under ``replicated`` placement the stacks are
+        committed to the mesh once by the placement cache, so steady-state
+        rounds move only the slot ids (explicit device_put).  ``timer``
+        accounts the stage/dispatch phases.  Returns ``(new_params,
+        per-client metric sums)`` with the metric sums still on device.
         """
         if self._train is None:
             self._train = self._build_train()
-        n_dev = self.mesh.shape["clients"]
-        user_idx = np.asarray(user_idx, np.int32)
-        if self.placement == "sharded":
-            u_pad = int(data[0].shape[0])
-            if u_pad % n_dev:
-                raise ValueError(
-                    f"sharded placement needs the user axis ({u_pad}) padded to a "
-                    f"multiple of the clients axis ({n_dev}); use shard_client_data")
-            per = u_pad // n_dev
-            owners = user_idx // per
-            by_dev = [user_idx[owners == d] for d in range(n_dev)]
-            slots = max(1, max(len(b) for b in by_dev))
-            user_glob = -np.ones((n_dev, slots), np.int32)
-            user_loc = -np.ones((n_dev, slots), np.int32)
-            for d, b in enumerate(by_dev):
-                user_glob[d, : len(b)] = b
-                user_loc[d, : len(b)] = b - d * per
-            user_glob = user_glob.reshape(-1)
-            user_loc = user_loc.reshape(-1)
-        else:
-            a = len(user_idx)
-            pad = (-a) % n_dev
-            user_glob = np.concatenate([user_idx, -np.ones(pad, np.int32)])
-            user_loc = user_glob
-        args = tuple(data)
-        if self.fix_rates is not None:
-            args = args + (self.fix_rates,)
-        lr = jnp.asarray(lr, jnp.float32)
-        return self._train(params, key, lr, jnp.asarray(user_loc), jnp.asarray(user_glob), *args)
+        timer = timer if timer is not None else PhaseTimer()
+        with timer.phase("stage"):
+            n_dev = self.mesh.shape["clients"]
+            user_idx = np.asarray(user_idx, np.int32)
+            if self.placement == "sharded":
+                u_pad = int(data[0].shape[0])
+                if u_pad % n_dev:
+                    raise ValueError(
+                        f"sharded placement needs the user axis ({u_pad}) padded to a "
+                        f"multiple of the clients axis ({n_dev}); use shard_client_data")
+                per = u_pad // n_dev
+                owners = user_idx // per
+                by_dev = [user_idx[owners == d] for d in range(n_dev)]
+                slots = max(1, max(len(b) for b in by_dev))
+                user_glob = self._packer.buffer(("glob", n_dev, slots), (n_dev, slots))
+                user_loc = self._packer.buffer(("loc", n_dev, slots), (n_dev, slots))
+                for d, b in enumerate(by_dev):
+                    user_glob[d, : len(b)] = b
+                    user_loc[d, : len(b)] = b - d * per
+                user_glob = user_glob.reshape(-1)
+                user_loc = user_loc.reshape(-1)
+                args = tuple(data)  # committed P('clients') by shard_client_data
+            else:
+                a = len(user_idx)
+                pad = (-a) % n_dev
+                user_glob = self._packer.buffer(("rep", a + pad), (a + pad,))
+                user_glob[:a] = user_idx
+                user_loc = user_glob
+                args = self._staging.replicated("train_data", data)
+            if self.fix_rates is not None:
+                args = args + self._staging.replicated("fix_rates", (self.fix_rates,))
+            lr = self._staging.scalar(lr)
+            ug = self._staging.put(user_glob, spec=P("clients"))
+            ul = ug if user_loc is user_glob else self._staging.put(user_loc, spec=P("clients"))
+        with timer.phase("dispatch"):
+            return self._train(params, key, lr, ul, ug, *args)

@@ -1,0 +1,272 @@
+"""Staged placement + zero-resharding steady-state dispatch.
+
+The round engines (round_engine.py masked, grouped.py rate-grouped) are
+"one XLA program per round" designs, but until this layer existed the HOST
+still paid a per-round tax that eroded exactly the concurrency they exist
+for: the per-user data stacks were re-wrapped with ``jnp.asarray`` every
+round (an implicit reshard/upload whenever the committed sharding did not
+match the program's specs), ``level_placement='slices'`` re-broadcast the
+global params and re-resharded the replicated data into every level's
+sub-mesh on every call, slot-id packing reallocated identical layouts, and
+metric sums were fetched synchronously before the next round could
+dispatch (ADVICE r5 item 3).
+
+Four pieces remove that tax:
+
+* :class:`PlacementCache` -- commits operands to their final mesh placement
+  ONCE, keyed by the static ``(lo, hi)`` clients-axis device-row range of
+  the target sub-mesh (``None`` = the full mesh).  Steady-state rounds then
+  pass device-resident, correctly-sharded buffers straight into the jitted
+  programs: no implicit per-call resharding, no host bytes moved.  Every
+  placement is an EXPLICIT ``jax.device_put``, so the round path stays
+  clean under ``jax.transfer_guard_host_to_device("disallow")`` -- the
+  regression oracle in tests/test_staging.py.
+* :class:`SlotPacker` -- cached host-side slot-layout buffers: packing the
+  active-client ids into padded slot arrays reuses one preallocated buffer
+  per static layout key instead of reallocating every round.
+* :class:`PendingMetrics` / :class:`MetricsPipeline` -- per-round metric
+  sums stay ON DEVICE; the pipeline fetches them in batches of
+  ``fetch_every`` rounds (default 1 = reference parity), so round ``t+1``
+  dispatches while round ``t``'s sums transfer, and ``flush()`` drains at
+  eval boundaries (and before the driver exits).
+* :class:`PhaseTimer` -- wall-clock stage/dispatch/compute/fetch breakdown,
+  threaded into ``bench.py``'s ``extra`` dict and the fed drivers' per-round
+  info line, so placement regressions show up as a phase shift instead of
+  an undifferentiated slowdown.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class PlacementCache:
+    """Once-per-experiment placement of operands onto a mesh or its slices.
+
+    Entries are keyed by ``(name, srange)`` -- ``srange`` is the static
+    ``(lo, hi)`` clients-axis row range of a sub-mesh (``None`` = the full
+    mesh) -- and invalidated only when the *identity* of the source arrays
+    changes (a restage).  The cache holds references to both sources and
+    committed outputs, so the ``id()`` keys stay valid for its lifetime.
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._submeshes: Dict[Tuple[int, int], Mesh] = {}
+        self._placed: Dict[Any, Tuple[Tuple[int, ...], Any, Any]] = {}
+        self._scalars: Dict[Any, Any] = {}
+        self._broadcasters: Dict[Any, Any] = {}
+
+    def submesh(self, lo: int, hi: int) -> Mesh:
+        """The cached sub-mesh over clients-axis device rows ``[lo, hi)``."""
+        key = (lo, hi)
+        if key not in self._submeshes:
+            self._submeshes[key] = Mesh(self.mesh.devices[lo:hi], self.mesh.axis_names)
+        return self._submeshes[key]
+
+    def mesh_for(self, srange: Optional[Tuple[int, int]]) -> Mesh:
+        return self.mesh if srange is None else self.submesh(*srange)
+
+    def replicated(self, name: str, arrays: Sequence[Any],
+                   srange: Optional[Tuple[int, int]] = None,
+                   spec: P = P()) -> Tuple[Any, ...]:
+        """Commit ``arrays`` onto the (sub-)mesh with ``spec`` exactly once.
+
+        Steady-state calls with the same source arrays return the committed
+        buffers without touching the host or the interconnect.
+        """
+        key = (name, srange, spec)
+        src = tuple(id(a) for a in arrays)
+        hit = self._placed.get(key)
+        if hit is not None and hit[0] == src:
+            return hit[2]
+        sh = NamedSharding(self.mesh_for(srange), spec)
+        out = tuple(jax.device_put(a, sh) for a in arrays)
+        self._placed[key] = (src, tuple(arrays), out)
+        return out
+
+    def scalar(self, value, srange: Optional[Tuple[int, int]] = None,
+               dtype=np.float32):
+        """A device scalar cached by value (LR repeats for whole schedule
+        plateaus; re-putting it every round is an avoidable transfer).
+
+        One slot per (srange, dtype), replaced on a new value: per-round
+        schedules (cosine/exponential) would otherwise grow the cache -- and
+        leak device buffers -- for the experiment's lifetime."""
+        slot = (srange, np.dtype(dtype).name)
+        hit = self._scalars.get(slot)
+        if hit is None or hit[0] != float(value):
+            arr = jax.device_put(np.asarray(value, dtype),
+                                 NamedSharding(self.mesh_for(srange), P()))
+            self._scalars[slot] = (float(value), arr)
+            return arr
+        return hit[1]
+
+    def put(self, tree, srange: Optional[Tuple[int, int]] = None,
+            spec: P = P()):
+        """Uncached EXPLICIT placement for per-round values (slot ids, level
+        partials moving back to the full mesh).  Device-resident sources
+        move over the interconnect only; host sources are explicit H2D,
+        which the transfer guard permits (it exists to catch *implicit*
+        moves).
+
+        Numpy leaves are privately copied first: ``device_put`` may
+        ZERO-COPY-ALIAS an aligned host buffer for the device array's whole
+        lifetime (measured on CPU for replicated puts), so handing it a
+        caller-owned buffer that gets refilled next round -- the SlotPacker
+        contract -- would corrupt in-flight rounds once dispatch is
+        pipelined.  The copy is tiny (slot-id vectors) and makes buffer
+        reuse unconditionally safe.  NOTE: the result may likewise alias a
+        DEVICE source's shards (observed even with ``may_alias=False``) --
+        never donate it; use :meth:`broadcast` for donation-safe copies."""
+        tree = jax.tree_util.tree_map(
+            lambda a: a.copy() if isinstance(a, np.ndarray) else a, tree)
+        sh = NamedSharding(self.mesh_for(srange), spec)
+        return jax.device_put(tree, sh)
+
+    def broadcast(self, tree, srange: Optional[Tuple[int, int]] = None):
+        """Jitted replicate-copy onto the (sub-)mesh: private buffers that a
+        downstream program can DONATE.
+
+        ``device_put`` reuses the source buffer as a shard whenever the
+        target mesh contains the source's device, so donating its output
+        deletes the source array out from under the caller (measured on
+        jax 0.4.37 CPU; ``may_alias=False`` does not prevent it).  A jitted
+        ``x + 0`` with explicit ``out_shardings`` always materialises fresh
+        buffers, and as a compiled program it dispatches asynchronously --
+        the broadcast overlaps with other levels' work."""
+        fn = self._broadcasters.get(srange)
+        sh = NamedSharding(self.mesh_for(srange), P())
+        if fn is None:
+            fn = jax.jit(lambda t: jax.tree_util.tree_map(lambda a: a + 0, t),
+                         out_shardings=sh)
+            self._broadcasters[srange] = fn
+        # two steps: the explicit put moves the data onto the (sub-)mesh (a
+        # source committed to a SUPERSET of devices cannot enter the smaller
+        # jit), then the jitted copy severs any buffer aliasing
+        return fn(jax.device_put(tree, sh))
+
+    def memo(self, name: str, sources: Sequence[Any], build: Callable[[], Any]):
+        """Generic staged-computation cache (pad-and-commit paths in the
+        evaluator): ``build()`` runs once per distinct source identity."""
+        key = ("memo", name)
+        src = tuple(id(s) for s in sources)
+        hit = self._placed.get(key)
+        if hit is not None and hit[0] == src:
+            return hit[2]
+        val = build()
+        self._placed[key] = (src, tuple(sources), val)
+        return val
+
+
+class SlotPacker:
+    """Cached host-side slot packing.
+
+    ``buffer(key, shape)`` returns a preallocated int32 buffer filled with
+    -1 (the padding-slot id); callers write the active ids in place.  The
+    per-round numpy packing previously reallocated identical layouts
+    whenever the active-client count repeated -- with a fixed ``frac`` that
+    is every round.
+    """
+
+    def __init__(self):
+        self._bufs: Dict[Any, np.ndarray] = {}
+
+    def buffer(self, key, shape: Tuple[int, ...]) -> np.ndarray:
+        shape = tuple(shape)
+        buf = self._bufs.get(key)
+        if buf is None or buf.shape != shape:
+            buf = np.empty(shape, np.int32)
+            self._bufs[key] = buf
+        buf.fill(-1)
+        return buf
+
+
+class PhaseTimer:
+    """Wall-clock phase accounting for the round path.
+
+    Phases are free-form names; the engines use ``stage`` (host packing +
+    placement-cache lookups), ``dispatch`` (program calls returning) and
+    ``fetch`` (D2H metric assembly); bench.py adds ``compute``
+    (block_until_ready).  Cheap enough to leave always on.
+    """
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.calls: Dict[str, int] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def snapshot(self) -> Dict[str, float]:
+        return dict(self.totals)
+
+    def delta(self, since: Dict[str, float]) -> Dict[str, float]:
+        """Per-round breakdown: totals accumulated since ``since``."""
+        return {k: v - since.get(k, 0.0) for k, v in self.totals.items()
+                if v - since.get(k, 0.0) > 0.0}
+
+    def summary(self, ndigits: int = 4) -> Dict[str, float]:
+        return {k: round(v, ndigits) for k, v in sorted(self.totals.items())}
+
+
+class PendingMetrics:
+    """Per-round metric sums left on device; ``fetch()`` materialises them
+    on the host (D2H) once and caches the result.  ``assemble`` maps the
+    fetched tree to the caller-facing dict (the grouped engine packs
+    per-level slot vectors back into active-client order)."""
+
+    def __init__(self, device_tree, assemble: Optional[Callable[[Any], Any]] = None):
+        self._tree = device_tree
+        self._assemble = assemble
+        self._host = None
+
+    def fetch(self):
+        if self._host is None:
+            host = jax.tree_util.tree_map(np.asarray, self._tree)
+            self._host = self._assemble(host) if self._assemble is not None else host
+            self._tree = None  # release the device refs
+        return self._host
+
+
+class MetricsPipeline:
+    """Deferred metric fetch: round ``t+1`` dispatches while round ``t``'s
+    sums transfer.
+
+    ``push`` returns the (tag, host_metrics) pairs that became due --
+    everything pending once ``fetch_every`` rounds have accumulated
+    (``fetch_every=1``, the default, degenerates to synchronous fetch =
+    reference parity).  ``flush()`` drains unconditionally; call it at any
+    boundary that must observe every round's metrics (the fed drivers flush
+    at eval boundaries and before exit)."""
+
+    def __init__(self, fetch_every: int = 1):
+        self.fetch_every = max(1, int(fetch_every or 1))
+        self._pending: List[Tuple[Any, PendingMetrics]] = []
+
+    def push(self, tag, pending: PendingMetrics) -> List[Tuple[Any, Any]]:
+        self._pending.append((tag, pending))
+        if len(self._pending) >= self.fetch_every:
+            return self.flush()
+        return []
+
+    def flush(self) -> List[Tuple[Any, Any]]:
+        out = [(tag, p.fetch()) for tag, p in self._pending]
+        self._pending = []
+        return out
+
+    def __len__(self) -> int:
+        return len(self._pending)

@@ -1,3 +1,4 @@
+from .compile_cache import default_cache_dir, enable_persistent_cache  # noqa: F401
 from .checkpoint import (  # noqa: F401
     checkpoint_path,
     copy_best,

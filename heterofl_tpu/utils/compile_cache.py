@@ -1,0 +1,56 @@
+"""Persistent XLA compilation-cache wiring.
+
+BENCH_r05 measured ``compile_sec`` 40.3s for the flagship round program --
+about one full CPU round.  A warm persistent cache amortises that across
+bench runs, tier-1 test sessions and repeated experiments, so the fed entry
+drivers, ``tests/conftest.py`` and ``bench.py`` all route through here.
+
+The default cache dir is fingerprinted by the host CPU's feature flags:
+XLA:CPU AOT entries embed machine features, and loading a cache written on
+a different host risks SIGILL mid-run (observed: ``cpu_aot_loader.cc``
+feature-mismatch errors when this box was reprovisioned between rounds).
+An operator-set ``JAX_COMPILATION_CACHE_DIR`` always wins.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+from typing import Optional
+
+
+def cache_fingerprint() -> str:
+    """8-hex digest of the host CPU's feature flags (empty flags on
+    non-procfs platforms hash to a stable constant)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next((l for l in f if l.startswith("flags")), "")
+    except OSError:
+        flags = ""
+    return hashlib.sha1(flags.encode()).hexdigest()[:8]
+
+
+def default_cache_dir(root: Optional[str] = None) -> str:
+    """``<repo>/.jax_cache/<cpu-fingerprint>`` (root defaults to the
+    directory containing the ``heterofl_tpu`` package)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, ".jax_cache", cache_fingerprint())
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> str:
+    """Point jax at a persistent compilation cache and return the dir.
+
+    Safe to call before or after ``import jax``: the env var covers a
+    not-yet-imported jax (and any child processes), and a live config
+    update covers an already-imported one.
+    """
+    path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or path or default_cache_dir()
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", path)
+    os.makedirs(path, exist_ok=True)
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+    return path

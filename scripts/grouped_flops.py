@@ -28,6 +28,7 @@ import numpy as np
 from heterofl_tpu import config as C
 from heterofl_tpu.data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
 from heterofl_tpu.models import make_model
+from heterofl_tpu.analysis import cost_analysis_dict as _ca_dict
 from heterofl_tpu.parallel import GroupedRoundEngine, RoundEngine, make_mesh
 
 
@@ -71,7 +72,7 @@ def main():
     ug = jnp.asarray(user_idx)
     args = tuple(data) + ((jnp.asarray(eng.fix_rates),) if eng.fix_rates is not None else ())
     t0 = time.time()
-    masked = eng._train.lower(params, key, lr, ug, ug, *args).compile().cost_analysis()
+    masked = _ca_dict(eng._train.lower(params, key, lr, ug, ug, *args).compile())
     t_masked = time.time() - t0
     print(f"masked compiled in {t_masked:.0f}s: {masked['flops']:.3e} flops",
           file=sys.stderr, flush=True)
@@ -86,7 +87,7 @@ def main():
     for r in sorted(by, reverse=True):
         u = jnp.asarray(user_idx[by[r]])
         prog = grp._level_prog(r, len(by[r]))
-        ca = prog.lower(params, key, lr, u, *data).compile().cost_analysis()
+        ca = _ca_dict(prog.lower(params, key, lr, u, *data).compile())
         per_level[str(r)] = ca["flops"]
         print(f"level {r}: {ca['flops']:.3e} flops", file=sys.stderr, flush=True)
         # avals only (keeps the 'nothing is executed' contract): the combine
@@ -94,7 +95,7 @@ def main():
         s, c, _ = jax.eval_shape(prog, params, key, lr, u, *data)
         sums.append(s)
         cnts.append(c)
-    combine = grp._combine_prog(len(sums)).lower(params, sums, cnts).compile().cost_analysis()
+    combine = _ca_dict(grp._combine_prog(len(sums)).lower(params, sums, cnts).compile())
     t_grouped = time.time() - t0
     grouped_total = sum(per_level.values()) + combine["flops"]
     print(json.dumps({

@@ -20,6 +20,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Persistent XLA compile cache for the test gate: repeat tier-1 runs skip
+# the expensive round-program compiles (BENCH_r05 measured 40.3s for the
+# flagship program).  The dir is CPU-feature-fingerprinted per host; an
+# operator-set JAX_COMPILATION_CACHE_DIR wins (utils/compile_cache.py).
+from heterofl_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

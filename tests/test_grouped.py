@@ -243,6 +243,12 @@ def test_grouped_dynamic_mode_matches_masked():
                                    rtol=5e-2, atol=5e-4, err_msg=k)
 
 
+def _flops(compiled):
+    from heterofl_tpu.analysis import cost_analysis_dict
+
+    return cost_analysis_dict(compiled)["flops"]
+
+
 def test_grouped_flop_account():
     """The point of the engine: at a heterogeneous mix the grouped program
     spends a small fraction of the masked program's FLOPs (dense per-level
@@ -262,7 +268,7 @@ def test_grouped_flop_account():
     n_dev = 1
     ug = jnp.asarray(user_idx)
     args = tuple(data) + ((jnp.asarray(eng.fix_rates),) if eng.fix_rates is not None else ())
-    masked_flops = eng._train.lower(params, key, lr, ug, ug, *args).compile().cost_analysis()["flops"]
+    masked_flops = _flops(eng._train.lower(params, key, lr, ug, ug, *args).compile())
 
     grp = GroupedRoundEngine(cfg, mesh)
     by = {}
@@ -273,12 +279,11 @@ def test_grouped_flop_account():
     for r in sorted(by, reverse=True):
         u = jnp.asarray(np.asarray(user_idx[by[r]], np.int32))
         prog = grp._level_prog(r, len(by[r]))
-        grouped_flops += prog.lower(params, key, lr, u, *tuple(data)).compile().cost_analysis()["flops"]
+        grouped_flops += _flops(prog.lower(params, key, lr, u, *tuple(data)).compile())
         s, c, _ = prog(params, key, lr, u, *tuple(data))
         sums.append(s)
         cnts.append(c)
-    grouped_flops += grp._combine_prog(len(sums)).lower(
-        params, sums, cnts).compile().cost_analysis()["flops"]
+    grouped_flops += _flops(grp._combine_prog(len(sums)).lower(params, sums, cnts).compile())
 
     ratio = masked_flops / grouped_flops
     # at the tiny test widths ceil() keeps small levels relatively wide, so
