@@ -17,7 +17,19 @@ BENCH_SYNTH_N (train images, default 50000), BENCH_CPU=1 to force the
 virtual-CPU path (debug), BENCH_DEADLINE (total wall-clock budget in seconds
 for the whole bench incl. fallbacks, default 1500), BENCH_TPU_TIMEOUT
 (seconds the supervised TPU attempt may take before the CPU fallback;
-default = half the deadline), BENCH_SKIP_TPU=1 to skip the TPU attempt.
+default = half the deadline), BENCH_SKIP_TPU=1 to skip the TPU attempt,
+BENCH_STRATEGY=masked|grouped (primary engine), BENCH_SUPERSTEP=K to fuse K
+rounds per compiled dispatch (train_superstep; phases amortize per round),
+BENCH_BOTH=0/1 to disable/force the second-strategy record in
+extra.strategies (default: on except budget-constrained fallbacks),
+BENCH_FETCH_EVERY=K to batch the D2H metric fetch.
+
+'value' is like-for-like across strategies: the average per-round seconds
+over timed rounds EXCLUDING rounds that compiled a fresh program shape
+(grouped slot-bucket compiles, superstep shape changes; detected via
+engine.program_cache_size() growth), inverted to rounds/sec.
+extra.compile_cache carries persistent-cache hit/miss counts so recompiles
+are visible in the artifact.
 
 Deadline contract (VERDICT r1 item 1): the supervisor carves the deadline
 into TPU attempts (<= half), a tiny-model CPU fallback sized to print within
@@ -248,9 +260,17 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     from heterofl_tpu import config as C
     from heterofl_tpu.data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
+    from heterofl_tpu.fed.core import round_users
     from heterofl_tpu.models import make_model
     from heterofl_tpu.parallel import (MetricsPipeline, PendingMetrics, PhaseTimer,
                                        RoundEngine, make_mesh)
+    from heterofl_tpu.utils.compile_cache import install_cache_counters
+
+    # persistent-compile-cache visibility (ISSUE 2 satellite): hit/miss
+    # counts land in extra.compile_cache so a superstep recompile (a new
+    # program shape per K) is attributable instead of silently eating the
+    # ~40s flagship compile
+    cache_counters = install_cache_counters()
 
     hb("claiming devices")
     devs = jax.devices()  # first touch claims the tunnel -- the wedge point
@@ -312,12 +332,15 @@ def main():
     # on-device A/B for the ~3.9x FLOP reduction (MEASUREMENTS.md roofline)
     strategy = os.environ.get("BENCH_STRATEGY", "masked")
     rates_vec = np.asarray(cfg["model_rate"], np.float32)
-    if strategy == "grouped":
-        from heterofl_tpu.parallel import GroupedRoundEngine
 
-        engine = GroupedRoundEngine(cfg, mesh)
-    else:
-        engine = RoundEngine(model, cfg, mesh)
+    def make_engine(strat):
+        if strat == "grouped":
+            from heterofl_tpu.parallel import GroupedRoundEngine
+
+            return GroupedRoundEngine(cfg, mesh)
+        return RoundEngine(model, cfg, mesh)
+
+    engine = make_engine(strategy)
     data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
     hb(f"data staged + engine built (strategy {strategy})")
 
@@ -326,95 +349,197 @@ def main():
     # BENCH_FETCH_EVERY>1 to pipeline the D2H metric fetch behind the next
     # round's dispatch (parallel/staging.py; default 1 = synchronous parity)
     timer = PhaseTimer()
-    try:
-        # clamp to >=1 so the emitted fetch_every matches what the pipeline
-        # actually does (MetricsPipeline clamps internally too)
-        fetch_every = max(1, int(os.environ.get("BENCH_FETCH_EVERY") or 1))
-    except ValueError:
-        print(f"bench: ignoring malformed "
-              f"BENCH_FETCH_EVERY={os.environ['BENCH_FETCH_EVERY']!r}",
-              file=sys.stderr)
-        fetch_every = 1
+
+    def env_int(name, default):
+        try:
+            return max(1, int(os.environ.get(name) or default))
+        except ValueError:
+            print(f"bench: ignoring malformed {name}={os.environ[name]!r}",
+                  file=sys.stderr)
+            return default
+
+    # clamp to >=1 so the emitted fetch_every matches what the pipeline
+    # actually does (MetricsPipeline clamps internally too)
+    fetch_every = env_int("BENCH_FETCH_EVERY", 1)
+    # BENCH_SUPERSTEP=K: fuse K rounds into one lax.scan program
+    # (train_superstep) -- each timed dispatch then covers K rounds and the
+    # phase breakdown is amortized per round (the ISSUE 2 acceptance metric)
+    superstep = env_int("BENCH_SUPERSTEP", 1)
     pipe = MetricsPipeline(fetch_every)
+    base_key = jax.random.key(0)
 
-    def round_once(params, r):
-        user_idx = rng.permutation(users)[:n_active].astype(np.int32)
-        if strategy == "grouped":
-            params, pending = engine.train_round(params, user_idx, rates_vec[user_idx],
-                                                 data, 0.1, jax.random.key(r),
-                                                 timer=timer, async_metrics=True)
-        else:
-            params, ms = engine.train_round(params, jax.random.key(r), 0.1, user_idx,
-                                            data, timer=timer)
-            pending = PendingMetrics(ms)
-        return params, pending
+    def dispatch(eng, strat, params, i, tmr, rng_):
+        """One timed dispatch: a single round (superstep==1) or a fused
+        K-round superstep.  Returns (params, PendingMetrics)."""
+        if superstep > 1:
+            epoch0 = 1 + i * superstep
+            if strat == "grouped":
+                us = np.stack([
+                    np.asarray(round_users(jax.random.fold_in(base_key, epoch0 + j),
+                                           users, n_active))
+                    for j in range(superstep)])
+                return eng.train_superstep(params, base_key, epoch0, superstep,
+                                           us, rates_vec[us], data, timer=tmr)
+            return eng.train_superstep(params, base_key, epoch0, superstep, data,
+                                       num_active=n_active, timer=tmr)
+        user_idx = rng_.permutation(users)[:n_active].astype(np.int32)
+        if strat == "grouped":
+            return eng.train_round(params, user_idx, rates_vec[user_idx],
+                                   data, 0.1, jax.random.key(i),
+                                   timer=tmr, async_metrics=True)
+        params, ms = eng.train_round(params, jax.random.key(i), 0.1, user_idx,
+                                     data, timer=tmr)
+        return params, PendingMetrics(ms)
 
-    def emit(rps, dt, compile_s, ms, ms_round, rounds_done, rtimes):
+    def last_loss(fetched):
+        """Superstep fetches return a list of per-round dicts; take the
+        latest round's sums either way."""
+        return fetched[-1] if isinstance(fetched, list) else fetched
+
+    def steady_stats(rsec, compile_flags):
+        """Like-for-like 'value' statistic for BOTH strategies (ADVICE r5
+        item 1): the average per-round seconds EXCLUDING rounds that
+        compiled a fresh program (grouped slot-bucket compiles, superstep
+        shape changes), falling back to all rounds when every timed round
+        compiled.  Detected via engine.program_cache_size() growth."""
+        steady = [t for t, c in zip(rsec, compile_flags) if not c] or list(rsec)
+        return sum(steady) / len(steady)
+
+    def summarize(rsec, compile_flags, compile_s, tmr, phases0, rounds_done):
+        steady_avg = steady_stats(rsec, compile_flags)
+        n_compile = sum(bool(c) for c in compile_flags)
+        return {
+            "value": round(1.0 / steady_avg, 4),
+            "round_sec_avg": round(sum(rsec) / len(rsec), 3),
+            "round_sec_best": round(min(rsec), 3),
+            "round_sec_steady_avg": round(steady_avg, 3),
+            # rounds that compiled a fresh shape, ALWAYS reported -- when
+            # every round compiled the steady avg falls back to all rounds
+            # and the next flag says so instead of hiding the recompiles
+            "compile_rounds": n_compile,
+            "steady_excludes_compile_rounds": n_compile < len(rsec),
+            "compile_sec": round(compile_s, 1),
+            "rounds_timed": rounds_done,
+            # per-ROUND amortized host phases: one stage+dispatch+fetch
+            # cycle serves all K rounds of a superstep
+            "phases": {k: round(v, 4)
+                       for k, v in sorted(tmr.amortized(phases0, rounds_done * superstep).items())},
+        }
+
+    def measure(strat, eng, params0, tmr, hb_prefix="", on_round=None):
+        """Warmup + timed loop: THE single measurement procedure, shared by
+        the primary strategy (``on_round`` handles its pipelined fetch and
+        refined per-round emits) and the alternate-strategy record (default:
+        synchronous fetch) -- one copy, so the cross-strategy like-for-like
+        claim compares identical procedures.  Returns (summary, ctx) where
+        ctx carries rsec/flags/compile_s/phases0/ms for the caller."""
+        rng_ = np.random.default_rng(0)
+        t0 = time.time()
+        p, pending = dispatch(eng, strat, params0, 0, tmr, rng_)
+        jax.block_until_ready(p)
+        warm_ms = last_loss(pending.fetch())
+        compile_s = time.time() - t0
+        # phases are reported RELATIVE to this snapshot so the breakdown
+        # shows steady-state cost, not the warmup compile in 'dispatch'
+        phases0 = tmr.snapshot()
+        hb(f"{hb_prefix}compile done ({compile_s:.1f}s incl. warmup dispatch)")
+        ctx = {"compile_s": compile_s, "phases0": phases0,
+               "rsec": [], "flags": [], "ms": warm_ms, "ms_round": 0}
+        for r in range(1, timed_rounds + 1):
+            size0 = eng.program_cache_size()
+            t0 = time.time()
+            p, pending = dispatch(eng, strat, p, r, tmr, rng_)
+            with tmr.phase("compute"):
+                jax.block_until_ready(p)
+            ctx["rsec"].append((time.time() - t0) / superstep)
+            ctx["flags"].append(eng.program_cache_size() > size0)
+            if on_round is not None:
+                on_round(r, pending, ctx)
+            else:
+                with tmr.phase("fetch"):
+                    ctx["ms"] = last_loss(pending.fetch())
+            hb(f"{hb_prefix}round {r}/{timed_rounds} done "
+               f"({ctx['rsec'][-1]:.2f}s/round)")
+        return summarize(ctx["rsec"], ctx["flags"], compile_s, tmr, phases0,
+                         timed_rounds), ctx
+
+    def emit(ctx, rounds_done, strategies=None):
         # a degraded (non-flagship-volume / wrong-platform) run must not
         # pretend to be comparable to the 10 rps north star (VERDICT r4
         # item 5): vs_baseline is null unless this is the real program.
         # With BENCH_FETCH_EVERY>1 the loss lags the timed round by up to K
         # rounds; final_loss_round marks which round it belongs to so a
         # mid-run kill's salvaged line is not silently stale.
+        ms = ctx["ms"]
         loss = float(np.asarray(ms["loss_sum"]).sum() / np.asarray(ms["n"]).sum())
+        dt = steady_stats(ctx["rsec"], ctx["flags"])
+        rps = 1.0 / dt
+        summary = summarize(ctx["rsec"], ctx["flags"], ctx["compile_s"], timer,
+                            ctx["phases0"], rounds_done)
+        del summary["value"]  # the top-level "value" IS this number
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
         print(json.dumps({
             "metric": "federated_rounds_per_sec_cifar10_resnet18_a1-e1_100c",
             "value": round(rps, 4),
             "unit": "rounds/sec",
             "vs_baseline": None if degraded else round(rps / 10.0, 4),
             "extra": {"round_sec": round(dt, 3),
-                      # both statistics for BOTH strategies (ADVICE r5 item 1):
-                      # 'value' keeps its documented per-strategy semantics, but
-                      # cross-strategy comparisons should use like-for-like
-                      "round_sec_avg": round(sum(rtimes) / len(rtimes), 3),
-                      "round_sec_best": round(min(rtimes), 3),
-                      "phases": {k: round(v, 3)
-                                 for k, v in sorted(timer.delta(phases_warm).items())},
-                      "compile_sec": round(compile_s, 1),
+                      **summary,
                       "devices": len(devs), "platform": platform,
                       "active_clients": n_active, "users": users,
                       "n_train": n_train, "final_loss": round(loss, 4),
-                      "rounds_timed": rounds_done, "strategy": strategy,
+                      "strategy": strategy,
+                      "compile_cache": {
+                          "enabled": bool(cache_dir),
+                          "requests": cache_counters["requests"],
+                          "hits": cache_counters["hits"],
+                          "misses": cache_counters["requests"] - cache_counters["hits"]},
+                      **({"superstep_rounds": superstep} if superstep != 1 else {}),
                       **({"fetch_every": fetch_every,
-                          "final_loss_round": ms_round} if fetch_every != 1 else {}),
+                          "final_loss_round": ctx["ms_round"]} if fetch_every != 1 else {}),
+                      **({"strategies": strategies} if strategies else {}),
                       **({"degraded": degraded} if degraded else {})},
         }), flush=True)
 
-    # compile + warmup
-    hb("compiling (warmup round)")
-    t0 = time.time()
-    params, pending = round_once(params, 0)
-    jax.block_until_ready(params)
-    last_ms, last_ms_round = pending.fetch(), 0  # warmup metrics, synchronous
-    compile_s = time.time() - t0
-    # phases are reported RELATIVE to this snapshot so the breakdown shows
-    # steady-state cost, not the warmup compile baked into 'dispatch'
-    phases_warm = timer.snapshot()
-    hb(f"compile done ({compile_s:.1f}s incl. warmup round)")
-    # timed; a refined JSON line lands after EVERY round so a mid-run kill
-    # still leaves the supervisor a real measurement to forward.  The
-    # grouped strategy compiles per-level programs per slot-count bucket, so
-    # a timed round can hit a fresh-bucket compile; its 'value' statistic is
-    # the BEST (steady-state) round, the masked engine's the running average
-    # -- extra.round_sec_avg/_best carry both for either strategy.
-    rtimes = []
-    for r in range(1, timed_rounds + 1):
-        t0 = time.time()
-        params, pending = round_once(params, r)
-        with timer.phase("compute"):
-            jax.block_until_ready(params)
-        rtimes.append(time.time() - t0)
+    # primary strategy: a refined JSON line lands after EVERY timed round so
+    # a mid-run kill still leaves the supervisor a real measurement to
+    # forward.  'value' is the LIKE-FOR-LIKE statistic for both strategies
+    # (ADVICE r5 item 1): per-round steady average excluding fresh-compile
+    # rounds (extra.round_sec_avg/_best/_steady_avg carry the full picture).
+    hb("compiling (warmup dispatch)")
+
+    def on_round(r, pending, ctx):
         with timer.phase("fetch"):
             due = pipe.push(r, pending)
         if due:
-            last_ms_round, last_ms = due[-1]
-        dt = min(rtimes) if strategy == "grouped" else sum(rtimes) / len(rtimes)
-        hb(f"round {r}/{timed_rounds} done ({dt:.2f}s/round "
-           f"{'best' if strategy == 'grouped' else 'avg'})")
-        emit(1.0 / dt, dt, compile_s, last_ms, last_ms_round, r, rtimes)
+            ctx["ms_round"], ctx["ms"] = due[-1][0], last_loss(due[-1][1])
+        emit(ctx, r)
+
+    primary_summary, ctx = measure(strategy, engine, params, timer,
+                                   on_round=on_round)
     due = pipe.flush()
     if due:  # deferred-fetch tail: re-emit with the final round's loss
-        emit(1.0 / dt, dt, compile_s, due[-1][1], due[-1][0], timed_rounds, rtimes)
+        ctx["ms_round"], ctx["ms"] = due[-1][0], last_loss(due[-1][1])
+        emit(ctx, timed_rounds)
+
+    # both-strategy record (ISSUE 2 satellite): measure the OTHER engine on
+    # the same config so the grouped engine's small-width FLOP reduction
+    # lands in the BENCH_*.json trajectory, not only in scripts/
+    # grouped_flops.py.  Skipped on the budget-constrained fallback paths
+    # (the insurance line must print); BENCH_BOTH=0 disables, =1 forces.
+    both_default = "0" if (fallback or realwidth) else "1"
+    if os.environ.get("BENCH_BOTH", both_default) == "1":
+        alt = "grouped" if strategy != "grouped" else "masked"
+        hb(f"alt strategy {alt}: building engine")
+        try:
+            alt_summary, _ = measure(alt, make_engine(alt),
+                                     model.init(jax.random.key(0)),
+                                     PhaseTimer(), hb_prefix=f"[{alt}] ")
+        except Exception as e:  # the primary record must survive an alt crash
+            print(f"bench: alt strategy {alt} failed: {e!r}", file=sys.stderr)
+            alt_summary = {"error": repr(e)}
+        emit(ctx, timed_rounds,
+             strategies={strategy: primary_summary, alt: alt_summary})
 
 
 if __name__ == "__main__":

@@ -120,6 +120,19 @@ DEFAULT_CFG: Dict[str, Any] = {
     # metrics in K-round batches and a mid-batch checkpoint omits the not-
     # yet-fetched rounds from logger history (a perf knob, not a semantics one).
     "metrics_fetch_every": 1,
+    # fused multi-round superstep: compile lax.scan over K federated rounds
+    # into ONE jitted/donated program (parallel round_engine/grouped
+    # train_superstep) -- per-round sampling, dynamic rate re-roll, failure
+    # injection and the LR schedule all run in-jit, metrics accumulate on
+    # device and cross to the host once per superstep.  1 = one program per
+    # round (current behavior).  K>1 requires a stateless LR schedule (no
+    # ReduceLROnPlateau), eval_interval divisible by K, and
+    # metrics_fetch_every in {1, K} (the superstep IS the fetch batch);
+    # checkpoints/resume land on superstep boundaries.  Under the masked
+    # engine with replicated placement the per-round active set is sampled
+    # in-jit from the jax key stream (fed.core.round_users) -- NOT the
+    # drivers' numpy permutation stream used at superstep_rounds=1.
+    "superstep_rounds": 1,
     "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
     # Applied LAST by process_control: per-key overrides of any derived field

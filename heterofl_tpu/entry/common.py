@@ -33,7 +33,7 @@ from ..data import (
     stack_client_shards,
     stack_client_token_rows,
 )
-from ..fed.core import round_rates, validate_width_geometry
+from ..fed.core import round_rates, round_users, validate_width_geometry
 from ..models import make_model
 from ..parallel import MetricsPipeline, PendingMetrics, PhaseTimer, RoundEngine, make_mesh
 from ..parallel.evaluation import Evaluator
@@ -172,6 +172,36 @@ class FedExperiment:
                 f"pipeline, so the effective fetch batch is eval_interval rounds")
         if cfg.get("strategy", "masked") not in ("masked", "sliced", "grouped"):
             raise ValueError(f"Not valid strategy: {cfg.get('strategy')!r}")
+        # fused multi-round superstep (ISSUE 2): K rounds per compiled
+        # program.  The knob interacts with every host-boundary feature, so
+        # conflicts fail LOUDLY here instead of silently changing semantics.
+        self.superstep_rounds = max(1, int(cfg.get("superstep_rounds", 1) or 1))
+        if self.superstep_rounds > 1:
+            K = self.superstep_rounds
+            if cfg.get("strategy") == "sliced":
+                raise ValueError(
+                    "superstep_rounds>1 needs a mesh-native engine "
+                    "(strategy 'masked' or 'grouped'); 'sliced' is the "
+                    "host-orchestrated debug twin")
+            if isinstance(self.scheduler, PlateauScheduler):
+                raise ValueError(
+                    "superstep_rounds>1 evaluates the LR schedule in-jit from "
+                    "the round index; ReduceLROnPlateau feeds on eval metrics "
+                    "and cannot run inside a superstep (set superstep_rounds=1 "
+                    "or pick a stateless scheduler)")
+            if self.metrics_pipe.fetch_every not in (1, K):
+                raise ValueError(
+                    f"metrics_fetch_every={self.metrics_pipe.fetch_every} "
+                    f"conflicts with superstep_rounds={K}: a superstep fetches "
+                    f"its metrics exactly once per K rounds (set "
+                    f"metrics_fetch_every to 1 or {K})")
+            if eval_iv % K:
+                raise ValueError(
+                    f"eval_interval={eval_iv} must be a multiple of "
+                    f"superstep_rounds={K}: eval boundaries clamp the superstep "
+                    f"(K = min(superstep_rounds, rounds-to-next-eval)) and a "
+                    f"misaligned interval would silently recompile shorter "
+                    f"supersteps every cycle")
         self.alt_engine = None
         if cfg.get("strategy") == "sliced":
             from ..fed.sliced import SlicedFederation
@@ -294,6 +324,55 @@ class FedExperiment:
                                   tag0["phases"], ms_host)
         return params
 
+    def _superstep_schedule(self, epoch0: int, k: int) -> np.ndarray:
+        """Host-side [k, A] active-user draw from the superstep sampling
+        stream (fed.core.round_users): what the masked engine samples in-jit,
+        evaluated on the host where slot packing needs the ids (sharded
+        placement, grouped level grouping)."""
+        return np.stack([
+            np.asarray(round_users(jax.random.fold_in(self.host_key, epoch0 + r),
+                                   self.cfg["num_users"], self.num_active))
+            for r in range(k)])
+
+    def train_superstep(self, params, epoch0: int, k: int, logger: Logger):
+        """Run rounds ``epoch0 .. epoch0+k-1`` as ONE compiled program
+        (``superstep_rounds``): the round boundary leaves the host -- one
+        stage+dispatch cycle and one metric fetch serve all k rounds, and the
+        per-round phase breakdown is the amortized cost (PhaseTimer)."""
+        cfg = self.cfg
+        t0 = time.time()
+        phases0 = self.phase_timer.snapshot()
+        if cfg.get("strategy") == "grouped":
+            users = self._superstep_schedule(epoch0, k)
+            rates = np.stack([
+                np.asarray(round_rates(jax.random.fold_in(self.host_key, epoch0 + r),
+                                       cfg, jnp.asarray(users[r])))
+                for r in range(k)])
+            params, pending = self.alt_engine.train_superstep(
+                params, self.host_key, epoch0, k, users, rates,
+                self.train_data, timer=self.phase_timer)
+        else:
+            sched = None
+            if cfg.get("data_placement") == "sharded":
+                sched = self._superstep_schedule(epoch0, k)
+            params, pending = self.engine.train_superstep(
+                params, self.host_key, epoch0, k, self.train_data,
+                user_schedule=sched, num_active=self.num_active,
+                timer=self.phase_timer)
+        with self.phase_timer.phase("fetch"):
+            ms_rounds = pending.fetch()
+        dt = time.time() - t0
+        per_round = dt / k
+        phases = self.phase_timer.amortized(phases0, k)
+        if self._first_round_done:
+            self._round_times.extend([per_round] * k)
+        else:
+            self._first_round_done = True  # exclude the compile superstep
+        for r, ms in enumerate(ms_rounds):
+            self._log_train_round(logger, epoch0 + r, self.scheduler(epoch0 + r),
+                                  per_round, phases, ms)
+        return params
+
     def _log_train_round(self, logger: Logger, epoch: int, lr: float, dt: float,
                          phases: Dict[str, float], ms: Dict[str, np.ndarray]):
         """Log one (possibly deferred) round's train metrics + info lines."""
@@ -387,10 +466,23 @@ class FedExperiment:
                     self.scheduler.load_state_dict(blob["scheduler_state"])
         n_rounds = cfg["num_epochs"]["global"]
         eval_interval = max(1, int(cfg.get("eval_interval", 1) or 1))
-        for epoch in range(last_epoch, n_rounds + 1):
+        epoch = last_epoch
+        while epoch <= n_rounds:
             logger.safe(True)
-            lr = self.scheduler(epoch)
-            params = self.train_round(params, epoch, lr, logger)
+            # superstep length: clamp to the next eval boundary and the end
+            # of the run (K = min(superstep_rounds, rounds-to-next-eval));
+            # checkpoints therefore land on superstep boundaries.
+            k_eff = 1
+            if self.superstep_rounds > 1:
+                to_eval = eval_interval - ((epoch - 1) % eval_interval)
+                k_eff = min(self.superstep_rounds, to_eval, n_rounds - epoch + 1)
+                # a clamped tail still goes through the superstep path (k=1)
+                # so ONE sampling stream covers the whole run
+                params = self.train_superstep(params, epoch, k_eff, logger)
+            else:
+                lr = self.scheduler(epoch)
+                params = self.train_round(params, epoch, lr, logger)
+            epoch = epoch + k_eff - 1  # last round this iteration covered
             evaluated = epoch % eval_interval == 0 or epoch == n_rounds
             if evaluated:
                 self.evaluate(params, epoch, logger, label_split)
@@ -427,6 +519,7 @@ class FedExperiment:
                 if is_best:
                     copy_best(cfg["output_dir"], self.tag)
             logger.reset()
+            epoch += 1
         self._drain_metrics(logger)  # safety: nothing stays on device at exit
         return {"params": params, "bn_state": getattr(self, "bn_state", {}),
                 "logger": logger, "data_split": data_split, "label_split": label_split}

@@ -84,6 +84,7 @@ def validate_width_geometry(model: ModelDef, cfg: Dict[str, Any]) -> None:
 
 
 ROUND_RATE_SALT = 7
+USER_SAMPLE_SALT = 11
 
 
 def round_rates(round_key: jax.Array, cfg: Dict[str, Any],
@@ -94,6 +95,20 @@ def round_rates(round_key: jax.Array, cfg: Dict[str, Any],
     sliced engines -- all three must consume the identical stream or
     round-level engine equivalence silently becomes a PRNG artifact."""
     return sample_model_rates(jax.random.fold_in(round_key, ROUND_RATE_SALT), cfg, user_idx)
+
+
+def round_users(round_key: jax.Array, num_users: int, num_active: int) -> jnp.ndarray:
+    """The per-round active-client draw, salt included: THE one definition
+    of the superstep sampling stream (the jax twin of the drivers'
+    ``rng.permutation(num_users)[:num_active]``).  Consumed in-jit by the
+    masked superstep (replicated placement) and on the host when packing
+    slot schedules (sharded placement, grouped engine) -- every consumer
+    must use this function or superstep-vs-sequential equivalence silently
+    becomes a PRNG artifact.  Traceable (``round_key`` may be a traced
+    key)."""
+    perm = jax.random.permutation(
+        jax.random.fold_in(round_key, USER_SAMPLE_SALT), num_users)
+    return perm[:num_active].astype(jnp.int32)
 
 
 def snap_to_levels(rates, levels, rtol: float = 1e-5, atol: float = 1e-8) -> np.ndarray:

@@ -65,6 +65,7 @@ from jax.sharding import PartitionSpec as P
 from ..fed.core import combine_counted, embed_sliced_jnp, extract_sliced_jnp, snap_to_levels
 from ..models import make_model
 from ..models.spec import count_masks as make_count_masks
+from ..utils.optim import make_traced_lr_fn
 from .round_engine import RoundEngine, _ceil_div, _shard_map
 from .staging import PendingMetrics, PhaseTimer, PlacementCache, SlotPacker
 
@@ -110,6 +111,8 @@ class GroupedRoundEngine:
             self.levels[rate] = (model, RoundEngine(model, cfg, mesh=None))
         self._level_progs: Dict[Tuple, Any] = {}
         self._combine_progs: Dict[int, Any] = {}
+        self._superstep_progs: Dict[Tuple, Any] = {}
+        self._lr_fn = None  # built on first superstep (plateau raises there)
         self._slices: Dict[float, Tuple[int, int]] = {}
         # staged placement (ISSUE 1 tentpole): data stacks (and in slices
         # mode the per-level operands) are committed to their sub-meshes
@@ -171,6 +174,59 @@ class GroupedRoundEngine:
 
     # -- per-level program ---------------------------------------------
 
+    def _level_core(self, rate: float, params, key, lr, uarr, data,
+                    n_data: int = 1, data_axis=None):
+        """One level's per-device in-jit core (inside ``shard_map``): dense
+        local training of this device's ``uarr`` slots at ``rate`` and the
+        level's counted sums in SLICED shape.  NO collectives -- the callers
+        reduce: the per-level program psums sliced then embeds once, the
+        fused superstep embeds per device and joins a single global psum
+        (zero-pad embedding commutes with the sum exactly, so both
+        associations add the same addends elementwise)."""
+        gm = self.global_model
+        model_l, eng_l = self.levels[rate]
+        wr = rate / self.global_rate  # static for this core
+        lm_all = data[-1]
+        valid = (uarr >= 0).astype(jnp.float32)
+        ugid = jnp.maximum(uarr, 0)
+        if self.failure_rate > 0.0:
+            # same crash model + PRNG stream as the masked engine
+            fkey = jax.random.fold_in(key, 98)
+            alive = 1.0 - jax.vmap(
+                lambda u: jax.random.bernoulli(
+                    jax.random.fold_in(fkey, u), self.failure_rate)
+            )(ugid).astype(jnp.float32)
+            valid = valid * alive
+        sub = extract_sliced_jnp(params, gm.specs, gm.groups, wr)
+        slot_keys = jax.vmap(lambda u: jax.random.fold_in(key, 13 + u))(ugid)
+        lm = lm_all[ugid]
+        if self.is_lm:
+            rows = data[0][ugid]
+            trained, ms = jax.vmap(
+                lambda r_, l_, k_: eng_l._local_train_lm(
+                    sub, 1.0, r_, l_, k_, lr, scaler_rate=wr,
+                    data_axis=data_axis, n_data=n_data)
+            )(rows, lm, slot_keys)
+        else:
+            xs, ys, sms = data[0][ugid], data[1][ugid], data[2][ugid]
+            trained, ms = jax.vmap(
+                lambda x_, y_, m_, l_, k_: eng_l._local_train_vision(
+                    sub, 1.0, x_, y_, m_, l_, k_, lr, scaler_rate=wr,
+                    data_axis=data_axis, n_data=n_data)
+            )(xs, ys, sms, lm, slot_keys)
+        # counted sums in SLICED shape (within the slice the width mask is
+        # all-ones by construction; only the label-split restriction remains)
+        sub_shapes = {k: v.shape for k, v in sub.items()}
+        cms = jax.vmap(lambda l_, v_: jax.tree_util.tree_map(
+            lambda m: m * v_,
+            make_count_masks(sub_shapes, model_l.specs, model_l.groups, 1.0, l_)))(
+            lm, valid)
+        sum_l = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in sub}
+        cnt_l = {k: jnp.sum(cms[k], axis=0) for k in sub}
+        ms = {k: v * valid for k, v in ms.items()}
+        ms["rate"] = jnp.full(uarr.shape, rate, jnp.float32) * valid
+        return sum_l, cnt_l, ms
+
     def _level_prog(self, rate: float, slots: int, sub_mesh=None,
                     slice_range=None):
         """Jitted shard_map for one (rate level, slot count): dense local
@@ -184,56 +240,17 @@ class GroupedRoundEngine:
         if key_ in self._level_progs:
             return self._level_progs[key_]
         gm = self.global_model
-        model_l, eng_l = self.levels[rate]
         wr = rate / self.global_rate  # static for this program
         n_data = mesh.shape["data"]
         data_axis = "data" if n_data > 1 else None
 
         def body(params, key, lr, uarr, *data):
-            lm_all = data[-1]
-            valid = (uarr >= 0).astype(jnp.float32)
-            ugid = jnp.maximum(uarr, 0)
-            if self.failure_rate > 0.0:
-                # same crash model + PRNG stream as the masked engine
-                fkey = jax.random.fold_in(key, 98)
-                alive = 1.0 - jax.vmap(
-                    lambda u: jax.random.bernoulli(
-                        jax.random.fold_in(fkey, u), self.failure_rate)
-                )(ugid).astype(jnp.float32)
-                valid = valid * alive
-            sub = extract_sliced_jnp(params, gm.specs, gm.groups, wr)
-            slot_keys = jax.vmap(lambda u: jax.random.fold_in(key, 13 + u))(ugid)
-            lm = lm_all[ugid]
-            if self.is_lm:
-                rows = data[0][ugid]
-                trained, ms = jax.vmap(
-                    lambda r_, l_, k_: eng_l._local_train_lm(
-                        sub, 1.0, r_, l_, k_, lr, scaler_rate=wr,
-                        data_axis=data_axis, n_data=n_data)
-                )(rows, lm, slot_keys)
-            else:
-                xs, ys, sms = data[0][ugid], data[1][ugid], data[2][ugid]
-                trained, ms = jax.vmap(
-                    lambda x_, y_, m_, l_, k_: eng_l._local_train_vision(
-                        sub, 1.0, x_, y_, m_, l_, k_, lr, scaler_rate=wr,
-                        data_axis=data_axis, n_data=n_data)
-                )(xs, ys, sms, lm, slot_keys)
-            # counted sums in SLICED shape (within the slice the width mask
-            # is all-ones by construction; only the label-split restriction
-            # remains), then one zero-pad embed for the whole level
-            sub_shapes = {k: v.shape for k, v in sub.items()}
-            cms = jax.vmap(lambda l_, v_: jax.tree_util.tree_map(
-                lambda m: m * v_,
-                make_count_masks(sub_shapes, model_l.specs, model_l.groups, 1.0, l_)))(
-                lm, valid)
-            sum_l = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in sub}
-            cnt_l = {k: jnp.sum(cms[k], axis=0) for k in sub}
+            sum_l, cnt_l, ms = self._level_core(rate, params, key, lr, uarr,
+                                                data, n_data, data_axis)
             sum_l = jax.lax.psum(sum_l, "clients")
             cnt_l = jax.lax.psum(cnt_l, "clients")
             sum_l = embed_sliced_jnp(sum_l, gm.specs, gm.groups, wr)
             cnt_l = embed_sliced_jnp(cnt_l, gm.specs, gm.groups, wr)
-            ms = {k: v * valid for k, v in ms.items()}
-            ms["rate"] = jnp.full(uarr.shape, rate, jnp.float32) * valid
             return sum_l, cnt_l, ms
 
         data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
@@ -264,6 +281,15 @@ class GroupedRoundEngine:
         prog = jax.jit(merge, donate_argnums=(0, 1, 2))
         self._combine_progs[n_levels] = prog
         return prog
+
+    def program_cache_size(self) -> int:
+        """Total compiled specializations across this engine's programs
+        (per-level + combine + fused superstep); see
+        :meth:`~.round_engine.RoundEngine.program_cache_size`."""
+        progs = list(self._level_progs.values()) \
+            + list(self._combine_progs.values()) \
+            + list(self._superstep_progs.values())
+        return sum(p._cache_size() for p in progs)
 
     # -- host wrapper ---------------------------------------------------
 
@@ -359,3 +385,199 @@ class GroupedRoundEngine:
             return new_params, pending
         with timer.phase("fetch"):
             return new_params, pending.fetch()
+
+    # -- fused superstep ------------------------------------------------
+
+    def _fused_layout(self):
+        """(mode, level boundary table) of the fused round: 'slices' when
+        the static row partition exists and there is no data axis (a
+        collective inside a ``lax.switch`` branch is not uniform across
+        devices), else 'span'."""
+        if self.level_placement == "slices" and self._slices \
+                and self.mesh.shape["data"] == 1:
+            return "slices", [self._slices[r][0] for r in sorted(self._slices, reverse=True)]
+        return "span", None
+
+    def _superstep_prog(self, k: int, per_dev: int, mode: str):
+        """ONE jitted+donated ``shard_map`` program for ``k`` grouped rounds:
+        the five per-level programs AND the combine fused into a single XLA
+        program, wrapped in a ``lax.scan`` over the rounds (ISSUE 2).
+
+        ``mode='span'``: every device runs every level back-to-back (a
+        static python loop over the level table inside the scan body).
+        ``mode='slices'``: each device row runs ONLY its level's branch
+        (``lax.switch`` on the row's static slice assignment) -- the levels
+        execute concurrently because XLA schedules disjoint device groups,
+        not because the host dispatched them asynchronously.  Either way the
+        level partials are embedded to global shape per device, ONE global
+        psum joins them, and the counted-average combine runs in-program --
+        aggregation state never exists outside the program.
+
+        ``per_dev`` is the UNIFORM per-device-per-level slot count (one
+        count for all levels, bucketed by the caller), so the compile space
+        stays O(k-shapes x log A) -- a per-level-count key would recompile
+        combinatorially as the sampled mix varies."""
+        key_ = (k, per_dev, mode)
+        if key_ in self._superstep_progs:
+            return self._superstep_progs[key_]
+        gm = self.global_model
+        mesh = self.mesh
+        n_data = mesh.shape["data"]
+        data_axis = "data" if n_data > 1 else None
+        level_rates = sorted(self.levels, reverse=True)
+        lr_fn = self._lr_fn
+
+        def embed(tree, rate):
+            return embed_sliced_jnp(tree, gm.specs, gm.groups, rate / self.global_rate)
+
+        if mode == "slices":
+            # np (not jnp): an eager jnp array here would be an implicit H2D
+            # whenever a fresh slot bucket triggers a rebuild inside a
+            # transfer-guarded steady state; as an np closure constant it
+            # enters the program at trace time instead
+            level_los = np.asarray([self._slices[r][0] for r in level_rates],
+                                   np.int32)
+
+        def sbody(params, base_key, epoch0, sched, *data):
+            def step(p, xs):
+                t, srow = xs
+                key = jax.random.fold_in(base_key, t)
+                lr = lr_fn(t)
+                if mode == "span":
+                    # srow: [L, per_dev] -- this device's slots of EVERY level
+                    tot_s = tot_c = None
+                    ms_levels = []
+                    for li, rate in enumerate(level_rates):
+                        s_l, c_l, ms_l = self._level_core(
+                            rate, p, key, lr, srow[li], data, n_data, data_axis)
+                        s_l, c_l = embed(s_l, rate), embed(c_l, rate)
+                        tot_s = s_l if tot_s is None else \
+                            {n: tot_s[n] + s_l[n] for n in tot_s}
+                        tot_c = c_l if tot_c is None else \
+                            {n: tot_c[n] + c_l[n] for n in tot_c}
+                        ms_levels.append(ms_l)
+                    ms = {n: jnp.stack([m[n] for m in ms_levels])
+                          for n in ms_levels[0]}
+                else:
+                    # srow: [per_dev] -- this device's slots of ITS OWN level
+                    row = jax.lax.axis_index("clients")
+                    branch = jnp.sum(row >= level_los) - 1
+
+                    def mk(rate):
+                        def f(p_, key_l, lr_l, u_):
+                            s, c, m = self._level_core(rate, p_, key_l, lr_l,
+                                                       u_, data, 1, None)
+                            return embed(s, rate), embed(c, rate), m
+                        return f
+
+                    tot_s, tot_c, ms = jax.lax.switch(
+                        branch, [mk(r) for r in level_rates], p, key, lr, srow)
+                tot_s = jax.lax.psum(tot_s, "clients")
+                tot_c = jax.lax.psum(tot_c, "clients")
+                new_p = combine_counted(p, tot_s, tot_c)
+                return new_p, ms
+
+            epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
+            new_params, ms = jax.lax.scan(step, params, (epochs, sched))
+            return new_params, ms
+
+        data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
+        sched_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
+        ms_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
+        fn = _shard_map(
+            sbody, mesh,
+            in_specs=(P(), P(), P(), sched_spec) + data_specs,
+            out_specs=(P(), ms_spec),
+        )
+        prog = jax.jit(fn, donate_argnums=(0,))
+        self._superstep_progs[key_] = prog
+        return prog
+
+    def train_superstep(self, global_params: Dict[str, Any], base_key,
+                        epoch0: int, k: int, user_schedule: np.ndarray,
+                        rate_schedule: np.ndarray, data: Tuple,
+                        timer: PhaseTimer = None):
+        """Run ``k`` grouped rounds as ONE compiled program.
+
+        ``user_schedule``: int32 ``[k, A]`` active user ids per round (the
+        superstep sampling stream, :func:`~..fed.core.round_users`);
+        ``rate_schedule``: ``[k, A]`` absolute model rates drawn host-side
+        from the same per-round keys as the sequential wrapper
+        (:func:`~..fed.core.round_rates`) -- level membership is slot
+        bookkeeping, so the grouping happens here, once per superstep, and
+        the rounds themselves never touch the host.  Per-round keys are
+        ``fold_in(base_key, epoch0 + r)``; the LR schedule is evaluated
+        in-jit from the round index.  Returns ``(new_params,
+        PendingMetrics)`` whose ``fetch()`` yields a list of k per-round
+        metric dicts in active-client order."""
+        if self._lr_fn is None:
+            self._lr_fn = make_traced_lr_fn(self.cfg)
+        timer = timer if timer is not None else PhaseTimer()
+        with timer.phase("stage"):
+            n_dev = self.mesh.shape["clients"]
+            user_schedule = np.asarray(user_schedule, np.int32)
+            rate_schedule = np.asarray(rate_schedule)
+            if user_schedule.shape != rate_schedule.shape \
+                    or user_schedule.ndim != 2 or user_schedule.shape[0] != k:
+                raise ValueError(
+                    f"user/rate schedules must both be [k={k}, A], got "
+                    f"{user_schedule.shape} / {rate_schedule.shape}")
+            a = user_schedule.shape[1]
+            snapped = snap_to_levels(rate_schedule.reshape(-1), self.levels)
+            rate_schedule = snapped.reshape(k, a)
+            level_rates = sorted(self.levels, reverse=True)
+            mode, _ = self._fused_layout()
+            # per-round per-level positions into the A-vector (metric
+            # reassembly + slot packing share this)
+            positions = [[np.flatnonzero(rate_schedule[r] == lr_)
+                          for lr_ in level_rates] for r in range(k)]
+            if mode == "slices":
+                rows = {r: self._slices[r][1] - self._slices[r][0]
+                        for r in level_rates}
+                need = max(_ceil_div(len(pos), rows[lr_]) if len(pos) else 1
+                           for per_round in positions
+                           for lr_, pos in zip(level_rates, per_round))
+                per_dev = _bucket_pow2(need)
+                sched = self._packer.buffer(("gss_sl", k, n_dev, per_dev),
+                                            (k, n_dev * per_dev))
+                for r in range(k):
+                    for lr_, pos in zip(level_rates, positions[r]):
+                        lo = self._slices[lr_][0]
+                        sched[r, lo * per_dev: lo * per_dev + len(pos)] = \
+                            user_schedule[r][pos]
+            else:
+                need = max(_ceil_div(len(pos), n_dev) if len(pos) else 1
+                           for per_round in positions for pos in per_round)
+                per_dev = _bucket_pow2(need)
+                sched = self._packer.buffer(("gss_sp", k, len(level_rates), per_dev),
+                                            (k, len(level_rates), n_dev * per_dev))
+                for r in range(k):
+                    for li, pos in enumerate(positions[r]):
+                        sched[r, li, : len(pos)] = user_schedule[r][pos]
+            args = self._staging.replicated("train_data", data)
+            spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
+            sched_dev = self._staging.put(sched, spec=spec)
+            epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
+            prog = self._superstep_prog(k, per_dev, mode)
+        with timer.phase("dispatch"):
+            new_params, ms = prog(global_params, base_key, epoch0_dev,
+                                  sched_dev, *args)
+
+        def _assemble(host):
+            out = []
+            for r in range(k):
+                mr = {n: np.zeros(a, np.float32) for n in host}
+                for li, (lr_, pos) in enumerate(zip(level_rates, positions[r])):
+                    if not len(pos):
+                        continue
+                    for n in mr:
+                        if mode == "span":
+                            mr[n][pos] = host[n][r, li, : len(pos)]
+                        else:
+                            lo = self._slices[lr_][0]
+                            mr[n][pos] = host[n][r, lo * per_dev:
+                                                 lo * per_dev + len(pos)]
+                out.append(mr)
+            return out
+
+        return new_params, PendingMetrics(ms, assemble=_assemble)

@@ -36,13 +36,13 @@ except ImportError:  # pragma: no cover
         return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 from ..data.datasets import DATASET_STATS
-from ..fed.core import combine_counted, round_rates
+from ..fed.core import combine_counted, round_rates, round_users
 from .ring_attention import ring_attention
-from .staging import PhaseTimer, PlacementCache, SlotPacker
+from .staging import PendingMetrics, PhaseTimer, PlacementCache, SlotPacker
 from ..models.base import ModelDef
 from ..models.spec import count_masks as make_count_masks, mask_params, param_mask
 from ..ops.augment import augment_cifar, normalize_image
-from ..utils.optim import clip_by_global_norm, make_optimizer
+from ..utils.optim import clip_by_global_norm, make_optimizer, make_traced_lr_fn
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -104,6 +104,8 @@ class RoundEngine:
         self.scan_unroll = int(cfg.get("scan_unroll", 1) or 1)
         self._opt_init, self._opt_update = make_optimizer(cfg)
         self._train = None
+        self._superstep_progs: Dict[Tuple, Any] = {}
+        self._lr_fn = None  # built on first superstep (plateau raises there)
         self._sbn = None
         self._eval_users = None
         self._eval_global = None
@@ -296,76 +298,81 @@ class RoundEngine:
     # the round program
     # ------------------------------------------------------------------
 
-    def _build_train(self):
-        model, cfg = self.model, self.cfg
-        mesh = self.mesh
+    def _round_core(self, params, key, lr, user_loc, user_glob, data):
+        """One round's in-jit core, per device (runs inside ``shard_map``):
+        slot training + counted-average ``psum``.  Shared by the one-round
+        program (:meth:`_build_train`) and the K-round superstep scan
+        (:meth:`_build_superstep`), so the two paths are the same
+        computation by construction.
+
+        ``user_loc``: this device's slot of active users as indices into its
+        local view of the per-user data stacks (== ``user_glob`` under
+        replicated placement); ``user_glob``: the users' global ids, used
+        for all per-client randomness so results are placement- and
+        mesh-shape-invariant.  -1 = padding slot.  ``data`` carries the
+        fix-rates table as its last element in fix mode."""
+        model, cfg, mesh = self.model, self.cfg, self.mesh
         dynamic = cfg["model_split_mode"] == "dynamic"
-        num_users = cfg["num_users"]
-
         failure_rate = float(cfg.get("client_failure_rate", 0.0) or 0.0)
+        valid = (user_glob >= 0).astype(jnp.float32)
+        ugid = jnp.maximum(user_glob, 0)
+        if failure_rate > 0.0:
+            # net-new fault injection (the reference only models dropout
+            # implicitly via frac-sampling): a failed client trains but
+            # its update never reaches aggregation -- like a crash after
+            # local work. All-failed rounds degrade to the stale rule.
+            fkey = jax.random.fold_in(key, 98)
+            alive = 1.0 - jax.vmap(
+                lambda u: jax.random.bernoulli(jax.random.fold_in(fkey, u), failure_rate)
+            )(ugid).astype(jnp.float32)
+            valid = valid * alive
+        uidx = jnp.maximum(user_loc, 0)
+        if dynamic:
+            # the shared per-round rate stream (fed.core.round_rates):
+            # re-roll ALL users, index the active ones (ref fed.py:15-24)
+            rates_abs = round_rates(key, cfg, ugid)
+        else:
+            rates_abs = data[-1][ugid]  # fix_rates passed as last data arg
+        wr = rates_abs / self.global_rate
+        slot_keys = jax.vmap(lambda u: jax.random.fold_in(key, 13 + u))(ugid)
 
-        def body(params, key, lr, user_loc, user_glob, *data):
-            # user_loc: this device's slot of active users as indices into its
-            # local view of the per-user data stacks (== user_glob under
-            # replicated placement); user_glob: the users' global ids, used
-            # for all per-client randomness so results are placement- and
-            # mesh-shape-invariant.  -1 = padding slot.
-            a = user_glob.shape[0]
-            valid = (user_glob >= 0).astype(jnp.float32)
-            ugid = jnp.maximum(user_glob, 0)
-            if failure_rate > 0.0:
-                # net-new fault injection (the reference only models dropout
-                # implicitly via frac-sampling): a failed client trains but
-                # its update never reaches aggregation -- like a crash after
-                # local work. All-failed rounds degrade to the stale rule.
-                fkey = jax.random.fold_in(key, 98)
-                alive = 1.0 - jax.vmap(
-                    lambda u: jax.random.bernoulli(jax.random.fold_in(fkey, u), failure_rate)
-                )(ugid).astype(jnp.float32)
-                valid = valid * alive
-            uidx = jnp.maximum(user_loc, 0)
-            if dynamic:
-                # the shared per-round rate stream (fed.core.round_rates):
-                # re-roll ALL users, index the active ones (ref fed.py:15-24)
-                rates_abs = round_rates(key, cfg, ugid)
-            else:
-                rates_abs = data[-1][ugid]  # fix_rates passed as last data arg
-            wr = rates_abs / self.global_rate
-            slot_keys = jax.vmap(lambda u: jax.random.fold_in(key, 13 + u))(ugid)
+        if self.is_lm:
+            all_rows, all_lm = data[0], data[1]
+            rows = all_rows[uidx]
+            lm = all_lm[uidx]
+            n_data = mesh.shape["data"]
+            trained, ms = jax.vmap(
+                lambda w_, r_, l_, k_: self._local_train_lm(
+                    params, w_, r_, l_, k_, lr,
+                    data_axis="data" if n_data > 1 else None, n_data=n_data)
+            )(wr, rows, lm, slot_keys)
+        else:
+            all_x, all_y, all_m, all_lm = data[0], data[1], data[2], data[3]
+            xs, ys, sms, lm = all_x[uidx], all_y[uidx], all_m[uidx], all_lm[uidx]
+            n_data = mesh.shape["data"]
+            trained, ms = jax.vmap(
+                lambda w_, x_, y_, m_, l_, k_: self._local_train_vision(
+                    params, w_, x_, y_, m_, l_, k_, lr,
+                    data_axis="data" if n_data > 1 else None, n_data=n_data)
+            )(wr, xs, ys, sms, lm, slot_keys)
 
-            if self.is_lm:
-                all_rows, all_lm = data[0], data[1]
-                rows = all_rows[uidx]
-                lm = all_lm[uidx]
-                n_data = mesh.shape["data"]
-                trained, ms = jax.vmap(
-                    lambda w_, r_, l_, k_: self._local_train_lm(
-                        params, w_, r_, l_, k_, lr,
-                        data_axis="data" if n_data > 1 else None, n_data=n_data)
-                )(wr, rows, lm, slot_keys)
-            else:
-                all_x, all_y, all_m, all_lm = data[0], data[1], data[2], data[3]
-                xs, ys, sms, lm = all_x[uidx], all_y[uidx], all_m[uidx], all_lm[uidx]
-                n_data = mesh.shape["data"]
-                trained, ms = jax.vmap(
-                    lambda w_, x_, y_, m_, l_, k_: self._local_train_vision(
-                        params, w_, x_, y_, m_, l_, k_, lr,
-                        data_axis="data" if n_data > 1 else None, n_data=n_data)
-                )(wr, xs, ys, sms, lm, slot_keys)
+        shapes = {k: v.shape for k, v in params.items()}
+        cms = jax.vmap(lambda w_, l_, v_: jax.tree_util.tree_map(
+            lambda m: m * v_, make_count_masks(shapes, model.specs, model.groups, w_, l_)))(
+            wr, lm, valid)
+        summed = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in params}
+        counts = {k: jnp.sum(cms[k], axis=0) for k in params}
+        summed = jax.lax.psum(summed, "clients")
+        counts = jax.lax.psum(counts, "clients")
+        new_params = combine_counted(params, summed, counts)
+        ms = {k: v * valid for k, v in ms.items()}
+        ms["rate"] = rates_abs * valid
+        return new_params, ms
 
-            shapes = {k: v.shape for k, v in params.items()}
-            cms = jax.vmap(lambda w_, l_, v_: jax.tree_util.tree_map(
-                lambda m: m * v_, make_count_masks(shapes, model.specs, model.groups, w_, l_)))(
-                wr, lm, valid)
-            summed = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in params}
-            counts = {k: jnp.sum(cms[k], axis=0) for k in params}
-            summed = jax.lax.psum(summed, "clients")
-            counts = jax.lax.psum(counts, "clients")
-            new_params = combine_counted(params, summed, counts)
-            ms = {k: v * valid for k, v in ms.items()}
-            ms["rate"] = rates_abs * valid
-            return new_params, ms
-
+    def _data_specs(self) -> Tuple[P, ...]:
+        """shard_map in_specs of the ``data`` tuple (incl. the fix-rates
+        tail): per-user stacks are device-sharded under ``sharded``
+        placement, replicated otherwise."""
         per_user = P("clients") if self.placement == "sharded" else P()
         if self.is_lm:
             data_specs = (per_user, per_user)
@@ -373,12 +380,170 @@ class RoundEngine:
             data_specs = (per_user, per_user, per_user, per_user)
         if self.fix_rates is not None:
             data_specs = data_specs + (P(),)
+        return data_specs
+
+    def _build_train(self):
+        def body(params, key, lr, user_loc, user_glob, *data):
+            return self._round_core(params, key, lr, user_loc, user_glob, data)
+
         fn = _shard_map(
-            body, mesh,
-            in_specs=(P(), P(), P(), P("clients"), P("clients")) + data_specs,
+            body, self.mesh,
+            in_specs=(P(), P(), P(), P("clients"), P("clients")) + self._data_specs(),
             out_specs=(P(), P("clients")),
         )
         return jax.jit(fn, donate_argnums=(0,))
+
+    def _build_superstep(self, k: int, per_dev: int, in_jit: bool,
+                         num_active: int = 0):
+        """One jitted+donated program for ``k`` federated rounds: the round
+        boundary leaves the host (ISSUE 2 tentpole).
+
+        A ``lax.scan`` INSIDE the ``shard_map`` carries ``(params)`` across
+        rounds; per-round keys are ``fold_in(base_key, epoch)``, the LR
+        schedule is evaluated in-jit from the round index
+        (:func:`~..utils.optim.make_traced_lr_fn`), and with ``in_jit``
+        sampling (replicated placement) the active clients are drawn from
+        :func:`~..fed.core.round_users` inside the scan -- a steady-state
+        superstep moves no slot ids at all.  ``in_jit=False`` takes a
+        host-packed ``[k, slots]`` schedule as scan xs (sharded placement:
+        slot->owner packing is placement bookkeeping).  Per-round per-slot
+        metric sums come back stacked ``[k, slots]`` -- one fetch per
+        superstep."""
+        mesh = self.mesh
+        n_dev = mesh.shape["clients"]
+        slots_total = per_dev * n_dev
+        num_users = self.cfg["num_users"]
+        lr_fn = self._lr_fn
+
+        def sbody(params, base_key, epoch0, *rest):
+            if in_jit:
+                data = rest
+            else:
+                sched_ul, sched_ug = rest[0], rest[1]
+                data = rest[2:]
+
+            def step(p, xs):
+                if in_jit:
+                    (t,) = xs
+                    key = jax.random.fold_in(base_key, t)
+                    active = round_users(key, num_users, num_active)
+                    pad = jnp.full((slots_total - num_active,), -1, jnp.int32)
+                    padded = jnp.concatenate([active, pad])
+                    d = jax.lax.axis_index("clients")
+                    ug = jax.lax.dynamic_slice(padded, (d * per_dev,), (per_dev,))
+                    ul = ug
+                else:
+                    t, ul, ug = xs
+                    key = jax.random.fold_in(base_key, t)
+                new_p, ms = self._round_core(p, key, lr_fn(t), ul, ug, data)
+                return new_p, ms
+
+            epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
+            xs = (epochs,) if in_jit else (epochs, sched_ul, sched_ug)
+            new_params, ms = jax.lax.scan(step, params, xs)
+            return new_params, ms
+
+        sched_specs = () if in_jit else (P(None, "clients"), P(None, "clients"))
+        fn = _shard_map(
+            sbody, mesh,
+            in_specs=(P(), P(), P()) + sched_specs + self._data_specs(),
+            out_specs=(P(), P(None, "clients")),
+        )
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def train_superstep(self, params, base_key, epoch0: int, k: int,
+                        data: Tuple[jnp.ndarray, ...], user_schedule=None,
+                        num_active: Optional[int] = None,
+                        timer: PhaseTimer = None):
+        """Run ``k`` rounds as ONE compiled program (``superstep_rounds``).
+
+        Per-round keys are ``fold_in(base_key, epoch0 + r)`` -- the driver's
+        stream with ``base_key = host_key``.  Under replicated placement
+        with ``user_schedule=None`` the per-round active set is sampled
+        in-jit from :func:`~..fed.core.round_users` (``num_active`` defaults
+        to ``ceil(frac * num_users)``); under sharded placement a host
+        ``user_schedule`` int32 ``[k, A]`` drawn from the same stream is
+        required, packed here into owner-aligned slot arrays (scan xs).
+        Returns ``(new_params, PendingMetrics)`` whose ``fetch()`` yields a
+        LIST of k per-round metric dicts -- metrics accumulate on device and
+        cross to the host once per superstep."""
+        if self._lr_fn is None:
+            self._lr_fn = make_traced_lr_fn(self.cfg)
+        timer = timer if timer is not None else PhaseTimer()
+        with timer.phase("stage"):
+            n_dev = self.mesh.shape["clients"]
+            sched_args = ()
+            if user_schedule is not None:
+                user_schedule = np.asarray(user_schedule, np.int32)
+                if user_schedule.ndim != 2 or user_schedule.shape[0] != k:
+                    raise ValueError(
+                        f"user_schedule must be [k={k}, A], got {user_schedule.shape}")
+            if self.placement == "sharded":
+                if user_schedule is None:
+                    raise ValueError(
+                        "sharded placement needs a host user_schedule [k, A]: "
+                        "slot->owner packing is placement bookkeeping (draw it "
+                        "from fed.core.round_users to keep the superstep stream)")
+                u_pad = int(data[0].shape[0])
+                if u_pad % n_dev:
+                    raise ValueError(
+                        f"sharded placement needs the user axis ({u_pad}) padded to a "
+                        f"multiple of the clients axis ({n_dev}); use shard_client_data")
+                per = u_pad // n_dev
+                rows = [[user_schedule[r][user_schedule[r] // per == d]
+                         for d in range(n_dev)] for r in range(k)]
+                per_dev = max(1, max(len(b) for row in rows for b in row))
+                ug_buf = self._packer.buffer(("ss_glob", k, n_dev, per_dev),
+                                             (k, n_dev, per_dev))
+                ul_buf = self._packer.buffer(("ss_loc", k, n_dev, per_dev),
+                                             (k, n_dev, per_dev))
+                for r in range(k):
+                    for d, b in enumerate(rows[r]):
+                        ug_buf[r, d, : len(b)] = b
+                        ul_buf[r, d, : len(b)] = b - d * per
+                ug = self._staging.put(ug_buf.reshape(k, -1), spec=P(None, "clients"))
+                ul = self._staging.put(ul_buf.reshape(k, -1), spec=P(None, "clients"))
+                sched_args, in_jit, a = (ul, ug), False, 0
+                args = tuple(data)
+            else:
+                if user_schedule is not None:
+                    a = user_schedule.shape[1]
+                    per_dev = _ceil_div(a, n_dev)
+                    buf = self._packer.buffer(("ss_rep", k, per_dev * n_dev),
+                                              (k, per_dev * n_dev))
+                    buf[:, :a] = user_schedule
+                    ug = self._staging.put(buf, spec=P(None, "clients"))
+                    sched_args, in_jit = (ug, ug), False
+                else:
+                    a = int(num_active if num_active is not None
+                            else math.ceil(self.cfg["frac"] * self.cfg["num_users"]))
+                    per_dev = _ceil_div(a, n_dev)
+                    in_jit = True
+                args = self._staging.replicated("train_data", data)
+            if self.fix_rates is not None:
+                args = args + self._staging.replicated("fix_rates", (self.fix_rates,))
+            epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
+            pkey = (k, per_dev, in_jit, a)
+            prog = self._superstep_progs.get(pkey)
+            if prog is None:
+                prog = self._build_superstep(k, per_dev, in_jit, num_active=a)
+                self._superstep_progs[pkey] = prog
+        with timer.phase("dispatch"):
+            new_params, ms = prog(params, base_key, epoch0_dev, *sched_args, *args)
+
+        def _assemble(host):
+            return [{name: v[r] for name, v in host.items()} for r in range(k)]
+
+        return new_params, PendingMetrics(ms, assemble=_assemble)
+
+    def program_cache_size(self) -> int:
+        """Total compiled specializations across this engine's train
+        programs (round + superstep).  bench.py samples the growth per timed
+        round to flag fresh-compile rounds and exclude them from the
+        steady-state average."""
+        progs = ([self._train] if self._train is not None else []) \
+            + list(self._superstep_progs.values())
+        return sum(p._cache_size() for p in progs)
 
     def train_round(self, params, key, lr, user_idx, data: Tuple[jnp.ndarray, ...],
                     timer: PhaseTimer = None):

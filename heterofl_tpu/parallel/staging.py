@@ -219,6 +219,15 @@ class PhaseTimer:
         return {k: v - since.get(k, 0.0) for k, v in self.totals.items()
                 if v - since.get(k, 0.0) > 0.0}
 
+    def amortized(self, since: Dict[str, float], rounds: int) -> Dict[str, float]:
+        """Per-ROUND breakdown of a K-round superstep: the phase time
+        accumulated since ``since`` divided by the rounds it paid for.  One
+        stage+dispatch+fetch cycle serves all K rounds of a superstep, so
+        this is the honest per-round host cost to compare against
+        ``superstep_rounds=1`` (the ISSUE 2 acceptance metric)."""
+        rounds = max(1, int(rounds))
+        return {k: v / rounds for k, v in self.delta(since).items()}
+
     def summary(self, ndigits: int = 4) -> Dict[str, float]:
         return {k: round(v, ndigits) for k, v in sorted(self.totals.items())}
 

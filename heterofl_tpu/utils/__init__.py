@@ -8,4 +8,5 @@ from .checkpoint import (  # noqa: F401
 )
 from .logger import Logger  # noqa: F401
 from .metrics import Metric, accuracy, perplexity, summarize_sums  # noqa: F401
-from .optim import clip_by_global_norm, make_optimizer, make_scheduler  # noqa: F401
+from .optim import (clip_by_global_norm, make_optimizer, make_scheduler,  # noqa: F401
+                    make_traced_lr_fn)

@@ -135,6 +135,50 @@ def make_scheduler(cfg: Dict[str, Any]) -> Callable[[int], float]:
     raise ValueError("Not valid scheduler name")
 
 
+def make_traced_lr_fn(cfg: Dict[str, Any]) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """The in-jit twin of :func:`make_scheduler`: LR as a traced function of
+    the (1-indexed, possibly traced) global round index.
+
+    This is what lets the superstep driver (``train_superstep``) evaluate
+    the schedule from the round index carried inside ``lax.scan`` instead of
+    staging a host scalar per round.  Supported kinds are exactly the
+    stateless ``step -> lr`` schedules; ``ReduceLROnPlateau`` needs the eval
+    metric feed and raises here -- the config layer surfaces that as a loud
+    ``superstep_rounds`` conflict.  Values match :func:`make_scheduler` to
+    float32 resolution (the host path stages its f64 result to an f32 device
+    scalar; tests/test_superstep.py pins the agreement over 400 rounds)."""
+    name = cfg["scheduler_name"]
+    base = jnp.float32(cfg["lr"])
+    factor = jnp.float32(cfg.get("factor", 0.1))
+    if name == "None":
+        return lambda step: base
+    if name == "StepLR":
+        size = cfg["step_size"]
+        return lambda step: base * factor ** ((step - 1) // size)
+    if name == "MultiStepLR":
+        miles = jnp.asarray(sorted(cfg["milestones"]), jnp.int32)
+        return lambda step: base * factor ** jnp.sum(step - 1 >= miles)
+    if name == "ExponentialLR":
+        return lambda step: base * jnp.float32(0.99) ** (step - 1)
+    if name == "CosineAnnealingLR":
+        tmax = cfg["num_epochs"]["global"] if isinstance(cfg["num_epochs"], dict) else cfg["num_epochs"]
+        eta_min = jnp.float32(cfg.get("min_lr", 0.0))
+        return lambda step: eta_min + (base - eta_min) * (
+            1 + jnp.cos(jnp.pi * (step - 1).astype(jnp.float32) / tmax)) / 2
+    if name == "CyclicLR":
+        up = 2000
+
+        def _tri(x):
+            cycle = jnp.floor(1 + x / 2)
+            return jnp.maximum(0.0, 1 - jnp.abs(x - 2 * cycle + 1))
+
+        return lambda step: base + (10 * base - base) * _tri((step - 1).astype(jnp.float32) / up)
+    raise ValueError(
+        f"scheduler {name!r} is not a pure function of the round index and "
+        f"cannot run inside a superstep (set superstep_rounds=1 or pick a "
+        f"stateless schedule)")
+
+
 def _triangle(x: float) -> float:
     cycle = math.floor(1 + x / 2)
     xx = abs(x / 1 - 2 * cycle + 1)

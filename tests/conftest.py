@@ -26,11 +26,25 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # operator-set JAX_COMPILATION_CACHE_DIR wins (utils/compile_cache.py).
 from heterofl_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
 
-enable_persistent_cache()
+_CACHE_DIR = enable_persistent_cache()
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# The tier-1 gate MUST run with the persistent compile cache active: without
+# it every session re-pays the multi-second round-program compiles, and a
+# superstep recompile (one program shape per K, ISSUE 2) silently eats the
+# budget instead of showing up as a cache miss.  Fail the whole session
+# loudly if the wiring ever breaks.
+if not jax.config.jax_compilation_cache_dir:
+    raise RuntimeError(
+        "tier-1 gate requires the persistent XLA compile cache; "
+        "utils/compile_cache.enable_persistent_cache() did not take effect")
+if not os.path.isdir(jax.config.jax_compilation_cache_dir):
+    raise RuntimeError(
+        f"persistent compile cache dir {jax.config.jax_compilation_cache_dir!r} "
+        f"does not exist")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

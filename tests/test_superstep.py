@@ -1,0 +1,388 @@
+"""Fused multi-round superstep (ISSUE 2): ``lax.scan`` over K federated
+rounds in ONE jitted/donated program, for both engines.
+
+The contract under test: a K-round superstep is BIT-IDENTICAL (params,
+per-round metrics, PRNG stream) to K sequential dispatches consuming the
+same streams -- sampling from ``fed.core.round_users``, rates from
+``fed.core.round_rates``, per-round keys ``fold_in(base_key, epoch)``, LR
+from the traced schedule.  For the masked engine the sequential baseline is
+``train_round`` itself (the superstep scan body IS ``_round_core``); for
+the grouped engine the fused program joins the level partials with a single
+global psum where the sequential path psums per level, so the bit-exact
+baseline is K dispatches of the fused program (``train_superstep(k=1)``)
+and ``train_round`` agreement is pinned at association tolerance.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heterofl_tpu import config as C
+from heterofl_tpu.fed.core import round_rates, round_users
+from heterofl_tpu.models import make_model
+from heterofl_tpu.parallel import GroupedRoundEngine, RoundEngine, make_mesh, shard_client_data
+from heterofl_tpu.utils.optim import make_scheduler, make_traced_lr_fn
+
+from test_round import _vision_setup
+
+
+HOST_KEY = jax.random.key(0)
+
+
+def _lr_host(cfg, epoch):
+    """The sequential baselines consume the traced schedule host-evaluated
+    (f32), exactly what the superstep computes in-jit from the round index."""
+    return float(np.asarray(make_traced_lr_fn(cfg)(jnp.int32(epoch))))
+
+
+def _schedule(cfg, epoch0, k, num_active):
+    return np.stack([
+        np.asarray(round_users(jax.random.fold_in(HOST_KEY, epoch0 + r),
+                               cfg["num_users"], num_active))
+        for r in range(k)])
+
+
+def _assert_rounds_equal(seq_ms, ss_ms, k):
+    assert len(ss_ms) == k
+    for r in range(k):
+        for name in ("loss_sum", "score_sum", "n", "rate"):
+            np.testing.assert_array_equal(
+                np.asarray(seq_ms[r][name]), np.asarray(ss_ms[r][name]),
+                err_msg=f"round {r} metric {name}")
+
+
+# ---------------------------------------------------------------------------
+# the traced LR schedule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,extra", [
+    ("None", {}),
+    ("StepLR", {"step_size": 30}),
+    ("MultiStepLR", {"milestones": [100, 150]}),
+    ("ExponentialLR", {}),
+    ("CosineAnnealingLR", {"min_lr": 1e-4}),
+])
+def test_traced_lr_fn_matches_host_scheduler(name, extra):
+    cfg = {"scheduler_name": name, "lr": 0.1, "factor": 0.1, "step_size": 1,
+           "milestones": [100], "num_epochs": {"global": 400}, **extra}
+    host = make_scheduler(cfg)
+    traced = jax.jit(make_traced_lr_fn(cfg))
+    for e in (1, 2, 50, 99, 100, 101, 150, 151, 399, 400):
+        # f32 resolution: the traced fn computes pow/cos in f32 while the
+        # host schedule is f64 (then staged to an f32 device scalar anyway)
+        np.testing.assert_allclose(float(np.asarray(traced(jnp.int32(e)))),
+                                   host(e), rtol=1e-4, err_msg=f"{name}@{e}")
+
+
+def test_traced_lr_fn_rejects_plateau():
+    cfg = {"scheduler_name": "ReduceLROnPlateau", "lr": 0.1}
+    with pytest.raises(ValueError, match="superstep"):
+        make_traced_lr_fn(cfg)
+
+
+# ---------------------------------------------------------------------------
+# masked engine: superstep == K sequential train_round dispatches, bitwise
+# ---------------------------------------------------------------------------
+
+def _masked_sequential(cfg, model, mesh, data, epoch0, k, num_active):
+    eng = RoundEngine(model, cfg, mesh)
+    p = model.init(jax.random.key(0))
+    seq_ms = []
+    for r in range(k):
+        e = epoch0 + r
+        key = jax.random.fold_in(HOST_KEY, e)
+        uidx = np.asarray(round_users(key, cfg["num_users"], num_active))
+        p, ms = eng.train_round(p, key, _lr_host(cfg, e), uidx, data)
+        seq_ms.append({n: np.asarray(v) for n, v in ms.items()})
+    return p, seq_ms
+
+
+def test_superstep_masked_replicated_bit_identical():
+    """Replicated placement: sampling, rates and the LR schedule all run
+    in-jit inside the scan, and the K-round superstep reproduces K
+    sequential train_round dispatches bit for bit."""
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    k, epoch0, A = 3, 1, 4
+    p_seq, seq_ms = _masked_sequential(cfg, model, mesh, data, epoch0, k, A)
+
+    eng = RoundEngine(model, cfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, pending = eng.train_superstep(p, HOST_KEY, epoch0, k, data, num_active=A)
+    ss_ms = pending.fetch()
+    for name in p_seq:
+        np.testing.assert_array_equal(np.asarray(p_seq[name]), np.asarray(p[name]),
+                                      err_msg=name)
+    _assert_rounds_equal(seq_ms, ss_ms, k)
+
+
+@pytest.mark.slow
+def test_superstep_masked_sharded_bit_identical():
+    """Sharded placement: the slot->owner packing comes from a host-packed
+    [k, A] schedule drawn from the SAME stream; rounds are still bitwise
+    equal to sequential dispatches."""
+    cfg, ds, data = _vision_setup()
+    cfg = dict(cfg, data_placement="sharded")
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    data_s = shard_client_data(mesh, tuple(np.asarray(d) for d in data))
+    k, epoch0, A = 3, 1, 4
+    sched = _schedule(cfg, epoch0, k, A)
+
+    eng1 = RoundEngine(model, cfg, mesh)
+    p1 = model.init(jax.random.key(0))
+    seq_ms = []
+    for r in range(k):
+        e = epoch0 + r
+        key = jax.random.fold_in(HOST_KEY, e)
+        p1, ms = eng1.train_round(p1, key, _lr_host(cfg, e), sched[r], data_s)
+        seq_ms.append({n: np.asarray(v) for n, v in ms.items()})
+
+    eng2 = RoundEngine(model, cfg, mesh)
+    p2 = model.init(jax.random.key(0))
+    p2, pending = eng2.train_superstep(p2, HOST_KEY, epoch0, k, data_s,
+                                       user_schedule=sched)
+    ss_ms = pending.fetch()
+    for name in p1:
+        np.testing.assert_array_equal(np.asarray(p1[name]), np.asarray(p2[name]),
+                                      err_msg=name)
+    # sequential slot counts can differ per round; compare the ACTIVE slots'
+    # totals (slot order is owner-packed identically here)
+    for r in range(k):
+        assert float(seq_ms[r]["n"].sum()) == float(np.asarray(ss_ms[r]["n"]).sum())
+
+
+@pytest.mark.slow
+def test_superstep_masked_dynamic_and_failure_bit_identical():
+    """Dynamic rate re-roll AND failure injection inside the scan consume
+    the sequential per-round streams (fold_in(key, 7)/98)."""
+    cfg, ds, data = _vision_setup(control="1_8_0.5_iid_dynamic_a1-e1_bn_1_1")
+    cfg = dict(cfg, client_failure_rate=0.5)
+    model = make_model(cfg)
+    mesh = make_mesh(2, 1)
+    k, epoch0, A = 2, 5, 4
+    p_seq, seq_ms = _masked_sequential(cfg, model, mesh, data, epoch0, k, A)
+
+    eng = RoundEngine(model, cfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, pending = eng.train_superstep(p, HOST_KEY, epoch0, k, data, num_active=A)
+    ss_ms = pending.fetch()
+    for name in p_seq:
+        np.testing.assert_array_equal(np.asarray(p_seq[name]), np.asarray(p[name]),
+                                      err_msg=name)
+    _assert_rounds_equal(seq_ms, ss_ms, k)
+    rates = np.concatenate([np.asarray(m["rate"]) for m in ss_ms])
+    assert set(np.unique(rates).tolist()) <= {0.0, 1.0, 0.0625}
+
+
+@pytest.mark.slow
+def test_superstep_masked_lm_matches_sequential():
+    """LM path: XLA fuses the attention chain differently inside the scan
+    body than in the standalone round program (measured ~5e-10 abs drift on
+    CPU), so the LM pin is near-exact rather than bitwise; a semantic bug
+    (wrong key/round/slot) would show at O(1e-2)."""
+    from test_round import _lm_setup
+
+    cfg, data = _lm_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(2, 1)
+    k, epoch0, A = 2, 1, 4
+    p_seq, seq_ms = _masked_sequential(cfg, model, mesh, data, epoch0, k, A)
+    eng = RoundEngine(model, cfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, pending = eng.train_superstep(p, HOST_KEY, epoch0, k, data, num_active=A)
+    ss_ms = pending.fetch()
+    for name in p_seq:
+        np.testing.assert_allclose(np.asarray(p_seq[name]), np.asarray(p[name]),
+                                   rtol=1e-5, atol=1e-7, err_msg=name)
+    for r in range(k):
+        np.testing.assert_array_equal(seq_ms[r]["n"], np.asarray(ss_ms[r]["n"]))
+        np.testing.assert_array_equal(seq_ms[r]["rate"], np.asarray(ss_ms[r]["rate"]))
+        np.testing.assert_allclose(seq_ms[r]["loss_sum"],
+                                   np.asarray(ss_ms[r]["loss_sum"]),
+                                   rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# grouped engine: fused per-level programs + combine, scanned
+# ---------------------------------------------------------------------------
+
+def _grouped_schedules(cfg, epoch0, k, num_active):
+    users = _schedule(cfg, epoch0, k, num_active)
+    if cfg["model_split_mode"] == "dynamic":
+        rates = np.stack([
+            np.asarray(round_rates(jax.random.fold_in(HOST_KEY, epoch0 + r),
+                                   cfg, jnp.asarray(users[r])))
+            for r in range(k)])
+    else:
+        rates = np.asarray(cfg["model_rate"], np.float32)[users]
+    return users, rates
+
+
+@pytest.mark.parametrize("placement", ["span", "slices"])
+def test_superstep_grouped_bit_identical_to_sequential_fused(placement):
+    """K scanned rounds == K sequential dispatches of the fused round
+    program (train_superstep(k=1)), bit for bit, both layouts."""
+    cfg, ds, data = _vision_setup()
+    cfg = dict(cfg, level_placement=placement)
+    model = make_model(cfg)
+    k, epoch0, A = 2, 1, 4
+    users, rates = _grouped_schedules(cfg, epoch0, k, A)
+
+    g1 = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    p1 = model.init(jax.random.key(0))
+    seq_ms = []
+    for r in range(k):
+        p1, pend = g1.train_superstep(p1, HOST_KEY, epoch0 + r, 1,
+                                      users[r:r + 1], rates[r:r + 1], data)
+        seq_ms.extend(pend.fetch())
+
+    g2 = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    p2 = model.init(jax.random.key(0))
+    p2, pend = g2.train_superstep(p2, HOST_KEY, epoch0, k, users, rates, data)
+    ss_ms = pend.fetch()
+    for name in p1:
+        np.testing.assert_array_equal(np.asarray(p1[name]), np.asarray(p2[name]),
+                                      err_msg=name)
+    _assert_rounds_equal(seq_ms, ss_ms, k)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("placement", ["span", "slices"])
+def test_superstep_grouped_matches_train_round(placement):
+    """The fused program agrees with the per-level dispatch path
+    (train_round) at association tolerance: identical per-client math, one
+    global psum instead of per-level psums.  Metrics n/rate are exact."""
+    cfg, ds, data = _vision_setup()
+    cfg = dict(cfg, level_placement=placement)
+    model = make_model(cfg)
+    k, epoch0, A = 2, 1, 4
+    users, rates = _grouped_schedules(cfg, epoch0, k, A)
+
+    g1 = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    p1 = model.init(jax.random.key(0))
+    seq_ms = []
+    for r in range(k):
+        e = epoch0 + r
+        key = jax.random.fold_in(HOST_KEY, e)
+        p1, ms = g1.train_round(p1, users[r], rates[r], data, _lr_host(cfg, e), key)
+        seq_ms.append(ms)
+
+    g2 = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    p2 = model.init(jax.random.key(0))
+    p2, pend = g2.train_superstep(p2, HOST_KEY, epoch0, k, users, rates, data)
+    ss_ms = pend.fetch()
+    for name in p1:
+        np.testing.assert_allclose(np.asarray(p1[name]), np.asarray(p2[name]),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+    for r in range(k):
+        np.testing.assert_array_equal(seq_ms[r]["n"], ss_ms[r]["n"])
+        np.testing.assert_array_equal(seq_ms[r]["rate"], ss_ms[r]["rate"])
+        np.testing.assert_allclose(seq_ms[r]["loss_sum"], ss_ms[r]["loss_sum"],
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_superstep_grouped_dynamic_mode():
+    """Dynamic mode: host-drawn rate schedules (round_rates stream) group
+    the levels; the superstep trains and every active slot reports a
+    table rate."""
+    cfg, ds, data = _vision_setup(control="1_8_0.5_iid_dynamic_a1-b1-c1-d1-e1_bn_1_1")
+    model = make_model(cfg)
+    k, epoch0, A = 2, 3, 4
+    users, rates = _grouped_schedules(cfg, epoch0, k, A)
+    g = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    p = model.init(jax.random.key(0))
+    p, pend = g.train_superstep(p, HOST_KEY, epoch0, k, users, rates, data)
+    ss_ms = pend.fetch()
+    for r in range(k):
+        np.testing.assert_array_equal(ss_ms[r]["rate"], rates[r])
+        assert (ss_ms[r]["n"] > 0).all()
+        assert np.isfinite(ss_ms[r]["loss_sum"]).all()
+
+
+def test_grouped_fused_slices_falls_back_with_data_axis():
+    """A collective inside a lax.switch branch is not uniform across
+    devices, so the fused slices layout requires data=1 -- with a data axis
+    the superstep runs the span-fused program instead."""
+    # 3 levels so a 4-row clients axis still admits the slices partition
+    cfg, ds, data = _vision_setup(control="1_8_0.5_iid_fix_a1-b1-c1_bn_1_1")
+    cfg = dict(cfg, level_placement="slices")
+    g = GroupedRoundEngine(cfg, make_mesh(4, 2))
+    assert g.level_placement == "slices"  # the sequential path keeps slices
+    mode, _ = g._fused_layout()
+    assert mode == "span"
+    # without the data axis the fused layout IS the slices partition
+    g2 = GroupedRoundEngine(cfg, make_mesh(4, 1))
+    mode2, los = g2._fused_layout()
+    assert mode2 == "slices" and los[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# driver-level config validation + end-to-end superstep loop
+# ---------------------------------------------------------------------------
+
+def _driver_cfg(tmp_path, **over):
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name("1_8_0.5_iid_fix_a1-b1_bn_1_1")
+    cfg["data_name"] = "MNIST"
+    cfg["model_name"] = "conv"
+    cfg["synthetic"] = True
+    cfg["synthetic_sizes"] = {"train": 80, "test": 40}
+    cfg["output_dir"] = str(tmp_path)
+    cfg["override"] = {"num_epochs": {"global": 2, "local": 1},
+                       "conv": {"hidden_size": [4, 8]},
+                       "batch_size": {"train": 10, "test": 20}, **over}
+    return C.process_control(cfg)
+
+
+def test_driver_superstep_config_conflicts(tmp_path):
+    from heterofl_tpu.entry.common import FedExperiment
+
+    with pytest.raises(ValueError, match="metrics_fetch_every"):
+        FedExperiment(_driver_cfg(tmp_path, superstep_rounds=4,
+                                  metrics_fetch_every=3, eval_interval=4), 0)
+    with pytest.raises(ValueError, match="eval_interval"):
+        FedExperiment(_driver_cfg(tmp_path, superstep_rounds=4,
+                                  eval_interval=6), 0)
+    with pytest.raises(ValueError, match="ReduceLROnPlateau|stateless"):
+        FedExperiment(_driver_cfg(tmp_path, superstep_rounds=2,
+                                  eval_interval=2,
+                                  scheduler_name="ReduceLROnPlateau"), 0)
+    with pytest.raises(ValueError, match="mesh-native"):
+        FedExperiment(_driver_cfg(tmp_path, superstep_rounds=2,
+                                  eval_interval=2, strategy="sliced"), 0)
+    # metrics_fetch_every == K is the unified fetch batch, allowed
+    FedExperiment(_driver_cfg(tmp_path, superstep_rounds=2, eval_interval=2,
+                              metrics_fetch_every=2), 0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strategy", ["masked", "grouped"])
+def test_driver_superstep_end_to_end(tmp_path, strategy):
+    """The fed entry with superstep_rounds=2 runs the full loop (train ->
+    eval -> checkpoint on superstep boundaries) for both engines."""
+    from heterofl_tpu.entry import train_classifier_fed
+
+    # 5 rounds with K=2 exercise the clamped tail: supersteps of 2, 2, 1
+    # (the k=1 tail still runs through the superstep path, one stream),
+    # evals at rounds 2, 4 and the final round 5
+    ov = {"num_epochs": {"global": 5, "local": 1},
+          "conv": {"hidden_size": [8, 16]},
+          "batch_size": {"train": 10, "test": 20},
+          "superstep_rounds": 2, "eval_interval": 2, "strategy": strategy}
+    argv = ["--control_name", "1_8_0.5_iid_fix_a1-b1-c1_bn_1_1",
+            "--data_name", "MNIST", "--model_name", "conv",
+            "--synthetic", "1",
+            "--synthetic_sizes", json.dumps({"train": 200, "test": 80}),
+            "--output_dir", str(tmp_path),
+            "--override", json.dumps(ov)]
+    res = train_classifier_fed.main(argv)
+    hist = res[0]["logger"].history
+    assert len(hist["test/Global-Accuracy"]) == 3
+    assert len(hist["train/Local-Loss"]) == 3  # one mean per eval window
+    assert np.isfinite(hist["train/Local-Loss"]).all()
