@@ -79,6 +79,46 @@ def _cache_dir():
     return _CACHE_DIR
 
 
+def _load_staticcheck():
+    """Summarise the STATICCHECK.json artifact (the staticcheck auditor's
+    program report, ISSUE 3) for ``extra.staticcheck``: audit status, per-
+    program peak temp bytes from ``memory_analysis()``, lint finding count.
+    None when the artifact is absent/unreadable -- the bench still runs,
+    but a FRESH failing audit makes the bench refuse to record (see main).
+
+    ``stale`` flags an artifact older than the newest package source file:
+    a stale green artifact proves nothing about the current tree (the
+    record says so instead of implying a guarantee), and a stale FAILING
+    artifact no longer blocks a tree that may already be fixed -- rerun
+    ``python -m heterofl_tpu.staticcheck`` to refresh either way."""
+    path = os.path.join(_REPO, "STATICCHECK.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        artifact_mtime = os.path.getmtime(path)
+    except (OSError, json.JSONDecodeError):
+        return None
+    newest_src = 0.0
+    for dirpath, dirnames, filenames in os.walk(os.path.join(_REPO, "heterofl_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                try:
+                    newest_src = max(newest_src,
+                                     os.path.getmtime(os.path.join(dirpath, fn)))
+                except OSError:
+                    pass
+    progs = rec.get("programs") or {}
+    mem = {name: (p.get("memory") or {}).get("temp_size_in_bytes")
+           for name, p in progs.items()}
+    return {"ok": bool(rec.get("ok")),
+            "stale": newest_src > artifact_mtime,
+            "generated_at": rec.get("generated_at"),
+            "programs_audited": len(progs),
+            "lint_findings": len(rec.get("lint") or []),
+            "program_temp_bytes": {k: v for k, v in mem.items() if v}}
+
+
 def _force_cpu():
     for _v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
                "AXON_LOOPBACK_RELAY", "AXON_POOL_SVC_OVERRIDE"):
@@ -271,6 +311,27 @@ def main():
     # program shape per K) is attributable instead of silently eating the
     # ~40s flagship compile
     cache_counters = install_cache_counters()
+
+    # staticcheck gate (ISSUE 3 satellite): a bench record against a tree
+    # whose program audit FAILED would launder a known-broken round program
+    # into the trajectory -- refuse (still one JSON line, rc 0) unless the
+    # operator explicitly overrides.  An absent artifact does not block, and
+    # a STALE one (older than the newest package source) neither blocks nor
+    # vouches -- extra.staticcheck carries the stale flag either way.
+    staticcheck = _load_staticcheck()
+    if staticcheck is not None and not staticcheck["ok"] \
+            and not staticcheck["stale"] \
+            and os.environ.get("BENCH_SKIP_STATICCHECK") != "1":
+        print(json.dumps({
+            "metric": "federated_rounds_per_sec_cifar10_resnet18_a1-e1_100c",
+            "value": 0.0, "unit": "rounds/sec", "vs_baseline": None,
+            "extra": {"error": "STATICCHECK.json reports a failing program "
+                               "audit; refusing to record a bench run. Rerun "
+                               "`python -m heterofl_tpu.staticcheck` (or set "
+                               "BENCH_SKIP_STATICCHECK=1 to override).",
+                      "staticcheck": staticcheck},
+        }), flush=True)
+        return
 
     hb("claiming devices")
     devs = jax.devices()  # first touch claims the tunnel -- the wedge point
@@ -494,6 +555,7 @@ def main():
                           "requests": cache_counters["requests"],
                           "hits": cache_counters["hits"],
                           "misses": cache_counters["requests"] - cache_counters["hits"]},
+                      **({"staticcheck": staticcheck} if staticcheck else {}),
                       **({"superstep_rounds": superstep} if superstep != 1 else {}),
                       **({"fetch_every": fetch_every,
                           "final_loss_round": ctx["ms_round"]} if fetch_every != 1 else {}),
@@ -510,7 +572,10 @@ def main():
 
     def on_round(r, pending, ctx):
         with timer.phase("fetch"):
-            due = pipe.push(r, pending)
+            # tag with the last ROUND the dispatch covered, not the dispatch
+            # index: final_loss_round documents which round the (possibly
+            # deferred) loss belongs to, and one dispatch is K rounds
+            due = pipe.push(r * superstep, pending)
         if due:
             ctx["ms_round"], ctx["ms"] = due[-1][0], last_loss(due[-1][1])
         emit(ctx, r)

@@ -136,6 +136,77 @@ def snap_to_levels(rates, levels, rtol: float = 1e-5, atol: float = 1e-8) -> np.
     return snapped
 
 
+#: module_table rows whose backward pass re-runs the contraction twice
+#: (grad wrt inputs + grad wrt weights); everything else (norms, relu,
+#: pools) back-propagates at ~1x its forward cost.
+_MATMUL_LIKE = ("conv", "linear", "shortcut", "mha", "ff.l", "dec.l",
+                "embedding", "qk", "av")
+
+#: optimizer + width/label masking + clipping cost per parameter per step
+#: (SGD momentum update, weight decay, mask multiply, global-norm terms)
+_OPT_FLOPS_PER_PARAM = 10.0
+
+
+def level_flop_table(cfg: Dict[str, Any], rates: Optional[list] = None
+                     ) -> Dict[float, float]:
+    """Analytic per-client per-local-step training FLOPs at each level of the
+    rate table: THE one source of truth for level FLOP budgets.
+
+    Derived from the profiler's per-module MAC table
+    (:func:`~..analysis.summary.module_table`) rather than the bare
+    ``rate^2`` heuristic: forward = 2x MACs, backward = 2x forward for
+    matmul-like modules (input grad + weight grad) and ~1x for elementwise
+    ones, plus an optimizer/masking term per parameter and the
+    width-INDEPENDENT per-batch data-prep cost (normalize/augment) that
+    dominates tiny levels.  Consumers: the grouped engine's ``slices`` row
+    allocation (:meth:`~..parallel.grouped.GroupedRoundEngine._static_mesh_slices`),
+    the staticcheck FLOP-budget audit, and ``scripts/grouped_flops.py``.
+    Absolute values are a model, not a measurement -- compare *shares*
+    (:func:`level_flop_shares`) against ``cost_analysis()`` numbers."""
+    from ..analysis.summary import module_table
+
+    grate = cfg["global_model_rate"]
+    if rates is None:
+        rates = sorted({float(r) for r in cfg["model_rate"]}, reverse=True)
+    bs = cfg["batch_size"]["train"] if isinstance(cfg["batch_size"], dict) \
+        else cfg["batch_size"]
+    prep = 0.0
+    if cfg.get("data_shape"):
+        h, w, c = cfg["data_shape"]
+        # normalize: sub+div per pixel; CIFAR adds crop/flip augmentation
+        prep = 2.0 * bs * h * w * c
+        if str(cfg.get("data_name", "")).startswith("CIFAR"):
+            prep *= 3.0
+    out: Dict[float, float] = {}
+    for r in rates:
+        wr = float(r) / grate
+        fwd = bwd = 0.0
+        nparam = 0
+        for name, _insz, _outsz, p, macs in module_table(cfg, wr, bs):
+            fl = 2.0 * macs
+            fwd += fl
+            bwd += fl * (2.0 if any(t in name for t in _MATMUL_LIKE) else 1.0)
+            nparam += p
+        out[float(r)] = fwd + bwd + _OPT_FLOPS_PER_PARAM * nparam + prep
+    return out
+
+
+def level_flop_shares(cfg: Dict[str, Any],
+                      weights: Optional[Dict[float, float]] = None,
+                      rates: Optional[list] = None) -> Dict[float, float]:
+    """Normalized expected FLOP share of each rate level: ``weight x
+    per-step analytic cost`` (:func:`level_flop_table`), summing to 1.
+    ``weights`` defaults to uniform (equal client counts per level)."""
+    table = level_flop_table(cfg, rates)
+    w = {r: 1.0 for r in table} if weights is None \
+        else {float(r): float(v) for r, v in weights.items()}
+    raw = {r: w.get(r, 0.0) * f for r, f in table.items()}
+    tot = sum(raw.values())
+    if tot <= 0.0:
+        raise ValueError(f"level FLOP shares degenerate: weights {w}")
+    return {r: v / tot for r, v in raw.items()}
+
+
 def to_width_rates(model_rates: jnp.ndarray, cfg: Dict[str, Any]) -> jnp.ndarray:
     """Absolute model rate -> width/scaler rate relative to the global model
     (``scaler_rate = model_rate / global_model_rate``, ref fed.py:46,

@@ -25,6 +25,7 @@ from ..models.transformer import make_transformer
 from ..utils.optim import clip_by_global_norm, make_optimizer
 from .ring_attention import ring_attention
 from .round_engine import _shard_map
+from .staging import PlacementCache
 
 
 class SeqParallelLM:
@@ -57,6 +58,10 @@ class SeqParallelLM:
         self._opt_init, self._opt_update = make_optimizer(cfg)
         self._fwd = None
         self._step = None
+        # LR staged once per value (a per-call jnp.asarray wrap re-uploaded
+        # an identical scalar every step; staticcheck's no-asarray rule
+        # caught it -- ISSUE 3 satellite)
+        self._staging = PlacementCache(mesh)
 
     def init(self, key):
         return self.model.init(key)
@@ -82,6 +87,8 @@ class SeqParallelLM:
                 n = jax.lax.psum(n_loc, ("clients", "data"))
                 return lsum / jnp.maximum(n, 1e-6)
 
+            # staticcheck: allow(jit-needs-donation): inference-only forward;
+            # params and batch are caller-owned and reused across calls
             self._fwd = jax.jit(_shard_map(
                 body, self.mesh,
                 in_specs=(P(), P(None, "data"), P(None, "data"), P()),
@@ -107,10 +114,13 @@ class SeqParallelLM:
                 params, opt = self._opt_update(params, grads, opt, lr)
                 return params, opt, lsum / jnp.maximum(n, 1e-6)
 
+            # staticcheck: allow(jit-needs-donation): train_step's public
+            # contract lets callers keep the previous (params, opt) -- the
+            # checkpoint/rollback paths do; donation would delete them
             self._step = jax.jit(_shard_map(
                 body, self.mesh,
                 in_specs=(P(), P(), P(None, "data"), P(None, "data"), P(), P()),
                 out_specs=(P(), P(), P())))
         if w is None:
             w = jnp.ones(labels.shape, jnp.float32)
-        return self._step(params, opt, labels, w, key, jnp.asarray(lr, jnp.float32))
+        return self._step(params, opt, labels, w, key, self._staging.scalar(lr))

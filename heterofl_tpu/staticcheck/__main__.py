@@ -1,0 +1,110 @@
+"""CLI: ``python -m heterofl_tpu.staticcheck [--json] [...]``.
+
+Runs the AST lint (jax-free, milliseconds) and then the program audit
+(lowers/compiles the flagship program matrix on a CPU mesh).  Exits 0 only
+when both fronts are clean; writes the ``STATICCHECK.json`` artifact that
+``bench.py`` folds into ``extra.staticcheck`` (and refuses to record
+against when stale-failed).
+
+The env scrub below MUST run before jax initialises: this environment
+boots a TPU-tunnel PJRT plugin via sitecustomize that pins
+``jax_platforms`` and hangs CPU-only init (see tests/conftest.py), and the
+audit needs an 8-device virtual CPU platform for the slices placement.
+``heterofl_tpu.staticcheck`` itself stays jax-free so the lint front (and
+``--skip-audit``) never boots a backend at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import os
+import sys
+from datetime import datetime, timezone
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _scrub_env_for_cpu_audit() -> None:
+    for v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+              "AXON_LOOPBACK_RELAY", "AXON_POOL_SVC_OVERRIDE"):
+        os.environ.pop(v, None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m heterofl_tpu.staticcheck",
+        description="jaxpr/HLO program auditor + hot-path lint gate")
+    parser.add_argument("--json", action="store_true",
+                        help="print the full report as JSON (default: "
+                             "findings + one summary line)")
+    parser.add_argument("--flagship", action="store_true",
+                        help="audit at full CIFAR-10 ResNet-18 widths "
+                             "(slower; tightens the FLOP-share tolerance "
+                             "to 2%%)")
+    parser.add_argument("--skip-audit", action="store_true",
+                        help="lint only (never imports jax)")
+    parser.add_argument("--skip-lint", action="store_true",
+                        help="program audit only")
+    parser.add_argument("--flop-tol", type=float, default=None,
+                        help="override the FLOP-share tolerance")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--lint-root", default=_REPO,
+                        help="tree to lint (default: this repo)")
+    parser.add_argument("--out", default=os.path.join(_REPO, "STATICCHECK.json"),
+                        help="artifact path (default: <repo>/STATICCHECK.json)")
+    parser.add_argument("--no-artifact", action="store_true",
+                        help="do not write the artifact file")
+    args = parser.parse_args(argv)
+
+    from .report import AuditReport
+    from .rules import lint_tree
+
+    lint_findings = []
+    if not args.skip_lint:
+        subdirs = ["heterofl_tpu"] if args.lint_root == _REPO else None
+        lint_findings = lint_tree(args.lint_root, subdirs=subdirs)
+
+    if args.skip_audit:
+        report = AuditReport()
+    else:
+        _scrub_env_for_cpu_audit()
+        from ..utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()  # amortise the program-matrix compiles
+        from .audit import run_audit
+
+        report = run_audit(flagship=args.flagship, flop_tol=args.flop_tol,
+                           seed=args.seed)
+    report.add_lint(lint_findings)
+    report.generated_at = datetime.now(timezone.utc).isoformat()
+    report.config["argv"] = list(argv) if argv is not None else sys.argv[1:]
+    report.config["skipped"] = {"audit": args.skip_audit,
+                                "lint": args.skip_lint}
+
+    if not args.no_artifact:
+        with open(args.out, "w") as f:
+            f.write(report.to_json())
+            f.write("\n")
+
+    if args.json:
+        print(report.to_json())
+    else:
+        for f in report.all_findings():
+            print(f)
+        n_prog = len(report.programs)
+        print(f"staticcheck: {'OK' if report.ok else 'FAILED'} -- "
+              f"{n_prog} programs audited, "
+              f"{len(report.all_findings())} finding(s)"
+              + ("" if args.no_artifact else f"; artifact: {args.out}"))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,555 @@
+"""Front 1: the compiled-program auditor.
+
+Lowers every flagship round-program variant -- masked + grouped engines x
+replicated/sharded (masked) and span/slices (grouped) placements x
+``superstep_rounds`` in {1, 8} -- on a CPU mesh and statically enforces:
+
+(a) **no host callbacks** (``pure_callback``/``io_callback``/
+    ``debug_callback``) and **no f64** anywhere in a round program;
+(b) **donation coverage** -- every donated leaf is consumed by input-output
+    aliasing in the optimized HLO, and JAX "donated buffers were not
+    usable" warnings are promoted to audit failures (silent memory
+    doubling);
+(c) **collectives budget** -- psum binds are counted per program and the
+    fused grouped round must perform EXACTLY ONE global psum (the PR 2
+    invariant), with every collective axis resolvable in the mesh;
+(d) **recompile hazard** -- two dispatches with fresh-but-identical host
+    inputs leave ``engine.program_cache_size()`` unchanged (weak-type /
+    python-scalar cache-key leaks recompile the ~40s flagship program);
+(e) **FLOP budget** -- ``cost_analysis()`` FLOPs per level program are
+    checked against the analytic shares from
+    :func:`~..fed.core.level_flop_shares` and ``memory_analysis()`` peak
+    bytes land in the STATICCHECK.json artifact.
+
+Widths: the default audit config keeps the flagship *structure* (5-level
+a1-e1 fix mix, both engines, both placements, K in {1, 8}) at test-scale
+widths so the whole matrix lowers+compiles in tens of seconds on a CPU --
+every property above except the FLOP-share tolerance is width-independent.
+``flagship=True`` swaps in the full CIFAR-10 ResNet-18 widths, where the
+conv terms dominate and the share tolerance tightens to 2%
+(``FLAGSHIP_FLOP_TOL``); at tiny widths the width-independent per-step
+costs (RNG, data prep, slicing) are a large fraction of the smallest
+levels, so the default tolerance is ``SMALL_FLOP_TOL`` and a strict
+monotonicity check carries the regression-catching weight instead.
+"""
+
+from __future__ import annotations
+
+import math
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .jaxpr_walk import (aliased_outputs, count_collectives, count_psum_over,
+                         donation_marks, find_callbacks, find_f64)
+from .report import AuditReport, Finding, ProgramReport
+
+#: FLOP-share tolerance (max relative error of measured vs analytic level
+#: shares).  2% holds where conv/matmul FLOPs dominate (flagship widths);
+#: the tiny-width gate config runs the same check at a documented looser
+#: bound plus strict share monotonicity.
+FLAGSHIP_FLOP_TOL = 0.02
+SMALL_FLOP_TOL = 0.45
+
+#: the PR 2 invariant: one global psum per (fused) round program
+PSUM_BUDGET = 1
+
+
+def default_audit_cfg(flagship: bool = False) -> Dict[str, Any]:
+    """The audit config: flagship federation structure (5-level a1-e1 fix
+    mix over 10 users, iid, BN) at test widths (``flagship=True``: full
+    CIFAR-10 ResNet-18 widths)."""
+    from .. import config as C
+
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name("1_10_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    cfg["data_name"] = "CIFAR10" if flagship else "MNIST"
+    cfg["model_name"] = "resnet18" if flagship else "conv"
+    cfg["synthetic"] = True
+    cfg = C.process_control(cfg)
+    if not flagship:
+        cfg["conv"] = {"hidden_size": [8, 16]}
+    cfg["classes_size"] = 10
+    return cfg
+
+
+def build_setup(flagship: bool = False, seed: int = 0) -> Dict[str, Any]:
+    """cfg + synthetic client-stacked data + model/params + 8-row CPU mesh.
+
+    Needs >= 5 mesh rows so the slices placement exists (tests/CLI force an
+    8-device host platform before jax initialises)."""
+    import jax
+
+    from ..data import (fetch_dataset, label_split_masks, split_dataset,
+                        stack_client_shards)
+    from ..models import make_model
+    from ..parallel import make_mesh
+
+    cfg = default_audit_cfg(flagship)
+    users = cfg["num_users"]
+    n_train = 2000 if flagship else 400
+    ds = fetch_dataset(cfg["data_name"], synthetic=True, seed=seed,
+                       synthetic_sizes={"train": n_train, "test": 100})
+    rng = np.random.default_rng(seed)
+    split, lsplit = split_dataset(ds, users, "iid", rng, classes_size=10)
+    x, y, m = stack_client_shards(ds["train"].data, ds["train"].target,
+                                  split["train"], list(range(users)))
+    lm = label_split_masks(lsplit, users, 10)
+    data = (x, y, m, lm)
+    model = make_model(cfg)
+    params = model.init(jax.random.key(seed))
+    n_dev = min(8, len(jax.devices()))
+    if n_dev < 5:
+        raise RuntimeError(
+            f"staticcheck audit needs >= 5 devices for the slices placement "
+            f"(have {n_dev}); set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8 before jax "
+            f"initialises (the CLI and tests/conftest.py both do)")
+    mesh = make_mesh(n_dev, 1)
+    return {"cfg": cfg, "data": data, "model": model, "params": params,
+            "mesh": mesh, "flagship": flagship, "key": jax.random.key(seed),
+            "lr": np.float32(0.05), "users": users}
+
+
+def _sds(shape: Tuple[int, ...], dtype=np.int32):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+# ---------------------------------------------------------------------------
+# the program matrix
+# ---------------------------------------------------------------------------
+
+def _masked_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
+    """(name, jitted program, example args, expectations) for the masked
+    engine: replicated + sharded placements x K in {1, 8}.  Arg shapes
+    mirror the engines' own staging math (slot padding/bucketing)."""
+    import jax
+
+    from ..parallel import RoundEngine, shard_client_data
+    from ..utils.optim import make_traced_lr_fn
+
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    params, key, lr = setup["params"], setup["key"], setup["lr"]
+    users = setup["users"]
+    n_dev = mesh.shape["clients"]
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    k = 8
+    targets = []
+
+    # replicated
+    eng = RoundEngine(model, cfg, mesh)
+    eng._lr_fn = make_traced_lr_fn(cfg)
+    fix = (eng.fix_rates,) if eng.fix_rates is not None else ()
+    data = tuple(setup["data"]) + fix
+    slots = users + ((-users) % n_dev)
+    targets.append((
+        "masked/replicated/k1", eng._build_train(),
+        (params, key, lr, _sds((slots,)), _sds((slots,))) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET}))
+    a = int(math.ceil(cfg["frac"] * users))
+    targets.append((
+        "masked/replicated/k8",
+        eng._build_superstep(k, _ceil_div(a, n_dev), True, num_active=a),
+        (params, key, np.int32(1)) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET}))
+
+    # sharded: per-user stacks device-sharded over the clients axis
+    eng_sh = RoundEngine(model, dict(cfg, data_placement="sharded"), mesh)
+    eng_sh._lr_fn = make_traced_lr_fn(cfg)
+    data_sh = shard_client_data(mesh, setup["data"]) + fix
+    per = _ceil_div(users, n_dev)
+    slots_sh = per * n_dev  # every device owns at most `per` active users
+    targets.append((
+        "masked/sharded/k1", eng_sh._build_train(),
+        (params, key, lr, _sds((slots_sh,)), _sds((slots_sh,))) + data_sh,
+        {"donated": n_leaves, "psum": PSUM_BUDGET}))
+    targets.append((
+        "masked/sharded/k8", eng_sh._build_superstep(k, per, False),
+        (params, key, np.int32(1), _sds((k, slots_sh)), _sds((k, slots_sh)))
+        + data_sh,
+        {"donated": n_leaves, "psum": PSUM_BUDGET}))
+    return targets
+
+
+def _grouped_targets(setup) -> Tuple[List, Dict[str, float], Any]:
+    """Targets for the grouped engine (span + slices x K in {1, 8} plus the
+    combine), the span per-level program names by rate (the FLOP-budget
+    check reads their measured flops), and the slices engine."""
+    import jax
+
+    from ..parallel import GroupedRoundEngine
+    from ..parallel.grouped import _bucket_pow2
+    from ..utils.optim import make_traced_lr_fn
+
+    cfg, mesh = setup["cfg"], setup["mesh"]
+    params, key, lr = setup["params"], setup["key"], setup["lr"]
+    n_dev = mesh.shape["clients"]
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    data = tuple(setup["data"])
+    k = 8
+    per_level = 2  # 10 users over 5 levels, all active: 2 clients per level
+
+    grp = GroupedRoundEngine(cfg, mesh)
+    grp._lr_fn = make_traced_lr_fn(cfg)
+    level_rates = sorted(grp.levels, reverse=True)
+    targets, level_prog_names = [], {}
+
+    slots = _bucket_pow2(_ceil_div(per_level, n_dev)) * n_dev
+    for rate in level_rates:
+        name = f"grouped/span/level-{rate:g}/k1"
+        level_prog_names[rate] = name
+        targets.append((
+            name, grp._level_prog(rate, slots),
+            (params, key, lr, _sds((slots,))) + data,
+            {"donated": 0, "psum": PSUM_BUDGET}))
+    psds = jax.tree_util.tree_map(
+        lambda v: _sds(v.shape, v.dtype), dict(params))
+    targets.append((
+        "grouped/span/combine", grp._combine_prog(len(level_rates)),
+        (params, [psds] * len(level_rates), [psds] * len(level_rates)),
+        {"donated": n_leaves, "psum": 0}))
+    per_dev = _bucket_pow2(_ceil_div(per_level, n_dev))
+    targets.append((
+        "grouped/span/k8-fused", grp._superstep_prog(k, per_dev, "span"),
+        (params, key, np.int32(1),
+         _sds((k, len(level_rates), per_dev * n_dev))) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET}))
+
+    grp_sl = GroupedRoundEngine(dict(cfg, level_placement="slices"), mesh)
+    grp_sl._lr_fn = make_traced_lr_fn(cfg)
+    if grp_sl.level_placement == "slices":
+        for rate in level_rates:
+            srange = grp_sl._slices[rate]
+            rows = srange[1] - srange[0]
+            slots_l = _bucket_pow2(_ceil_div(per_level, rows)) * rows
+            targets.append((
+                f"grouped/slices/level-{rate:g}/k1",
+                grp_sl._level_prog(rate, slots_l,
+                                   grp_sl._staging.submesh(*srange), srange),
+                (params, key, lr, _sds((slots_l,))) + data,
+                {"donated": n_leaves, "psum": PSUM_BUDGET}))
+        mode, _ = grp_sl._fused_layout()
+        if mode == "slices":
+            need = max(_ceil_div(per_level, grp_sl._slices[r][1] - grp_sl._slices[r][0])
+                       for r in level_rates)
+            per_dev_sl = _bucket_pow2(need)
+            targets.append((
+                "grouped/slices/k8-fused",
+                grp_sl._superstep_prog(k, per_dev_sl, "slices"),
+                (params, key, np.int32(1), _sds((k, per_dev_sl * n_dev))) + data,
+                {"donated": n_leaves, "psum": PSUM_BUDGET}))
+    return targets, level_prog_names, grp_sl
+
+
+# ---------------------------------------------------------------------------
+# per-program checks
+# ---------------------------------------------------------------------------
+
+def audit_program(name: str, prog, args: Tuple, expect: Dict[str, Any],
+                  mesh) -> ProgramReport:
+    """Trace, lower and compile one program; run checks (a)-(c) and record
+    flops/memory for (e).  Never executes the program."""
+    from ..analysis import cost_analysis_dict
+
+    rep = ProgramReport(name=name, donation_expected=int(expect["donated"]))
+    jaxpr = prog.trace(*args).jaxpr
+    for prim, prov in find_callbacks(jaxpr):
+        rep.fail("no-host-callback",
+                 f"host callback op `{prim}` inside the round program "
+                 f"(bound at {prov}): one callback serialises the whole "
+                 f"fused round on the host boundary")
+    for what, prov in find_f64(jaxpr):
+        rep.fail("no-f64", f"{what} (bound at {prov})")
+
+    counts, axes = count_collectives(jaxpr)
+    rep.psum_clients = count_psum_over(jaxpr, "clients")
+    rep.all_gather = counts.get("all_gather", 0)
+    rep.collective_axes = sorted(axes)
+    mesh_axes = set(mesh.axis_names)
+    bad_axes = axes - mesh_axes
+    if bad_axes:
+        rep.fail("collective-axis",
+                 f"collective axes {sorted(bad_axes)} not resolvable in the "
+                 f"mesh axes {sorted(mesh_axes)}")
+    if rep.psum_clients != expect["psum"]:
+        rep.fail("psum-budget",
+                 f"{rep.psum_clients} global psum bind(s) over the clients "
+                 f"axis, budget is exactly {expect['psum']}")
+    if rep.all_gather:
+        rep.fail("collective-budget",
+                 f"{rep.all_gather} all_gather bind(s); the round programs "
+                 f"move aggregates through the single psum only")
+    if any(f.rule == "no-host-callback" for f in rep.findings):
+        # a host callback is fatal on its own AND may refuse to lower under
+        # a mesh -- report what the jaxpr walk found and stop here
+        return rep
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        lowered = prog.lower(*args)
+        compiled = lowered.compile()
+    for w in caught:
+        msg = str(w.message)
+        if "donated" in msg.lower() or "donation" in msg.lower():
+            rep.fail("donation-unused",
+                     f"jax donation warning promoted to failure: {msg[:300]}")
+
+    lowered_text = lowered.as_text()
+    compiled_text = compiled.as_text()
+    rep.donated = donation_marks(lowered_text)
+    rep.aliased = aliased_outputs(compiled_text)
+    if rep.donated != expect["donated"]:
+        rep.fail("donation-coverage",
+                 f"{rep.donated} donated input leaves at lowering, expected "
+                 f"{expect['donated']} (params/opt-state coverage)")
+    if rep.aliased != expect["donated"]:
+        rep.fail("donation-consumed",
+                 f"only {rep.aliased}/{expect['donated']} donated leaves "
+                 f"were consumed by input-output aliasing in the compiled "
+                 f"program -- unconsumed donation is silent memory doubling")
+
+    try:
+        rep.flops = float(cost_analysis_dict(compiled).get("flops", float("nan")))
+    except Exception as e:  # cost analysis availability varies by backend
+        rep.flops = None
+        rep.findings.append(Finding("cost-analysis", name,
+                                    f"cost_analysis unavailable: {e!r} "
+                                    f"(informational)"))
+    try:
+        ma = compiled.memory_analysis()
+        rep.memory = {k: int(getattr(ma, k)) for k in
+                      ("temp_size_in_bytes", "argument_size_in_bytes",
+                       "output_size_in_bytes", "generated_code_size_in_bytes")
+                      if hasattr(ma, k)} if ma is not None else None
+    except Exception:
+        rep.memory = None
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# cross-program checks: (d) recompile hazard, (e) FLOP budget
+# ---------------------------------------------------------------------------
+
+def recompile_hazard_check(setup) -> Dict[str, Any]:
+    """Dispatch each engine twice with FRESH but value-identical host inputs
+    (new numpy buffers, new python floats) and require
+    ``engine.program_cache_size()`` to stay flat after the first call --
+    the classic leaks (weak-typed scalars, python floats in cache keys,
+    re-bucketed slots) all show up as growth here."""
+    import jax
+
+    from ..parallel import GroupedRoundEngine, RoundEngine, shard_client_data
+
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    data = tuple(setup["data"])
+    out: Dict[str, Any] = {"ok": True}
+
+    def fresh_idx():
+        return np.array([0, 2, 4, 6, 8, 1], dtype=np.int64)  # re-allocated
+
+    def fresh_lr():
+        return float("0.05")  # a NEW python float each dispatch
+
+    eng = RoundEngine(model, cfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, _ = eng.train_round(p, jax.random.key(1), fresh_lr(), fresh_idx(), data)
+    size1 = eng.program_cache_size()
+    p, _ = eng.train_round(p, jax.random.key(2), fresh_lr(), fresh_idx(), data)
+    out["masked_round"] = {"after_warm": size1,
+                           "after_repeat": eng.program_cache_size()}
+
+    p, pend = eng.train_superstep(p, jax.random.key(3), 1, 2, data,
+                                  num_active=4)
+    pend.fetch()
+    size1 = eng.program_cache_size()
+    p, pend = eng.train_superstep(p, jax.random.key(3), 3, 2, data,
+                                  num_active=4)
+    pend.fetch()
+    out["masked_superstep"] = {"after_warm": size1,
+                               "after_repeat": eng.program_cache_size()}
+
+    # sharded placement superstep: the host-packed slot schedule's ownership
+    # density keys the K-round program -- fresh-but-identical schedules must
+    # not recompile (per_dev bucketing regression, found by this very check)
+    from ..fed.core import round_users
+
+    eng_sh = RoundEngine(model, dict(cfg, data_placement="sharded"), mesh)
+    data_sh = shard_client_data(mesh, data)
+    base = jax.random.key(5)
+
+    def fresh_sched():
+        return np.stack([np.asarray(round_users(jax.random.fold_in(base, 1 + j),
+                                                setup["users"], 4))
+                         for j in range(2)])
+
+    ps = model.init(jax.random.key(0))
+    ps, pend = eng_sh.train_superstep(ps, base, 1, 2, data_sh,
+                                      user_schedule=fresh_sched())
+    pend.fetch()
+    size1 = eng_sh.program_cache_size()
+    ps, pend = eng_sh.train_superstep(ps, base, 3, 2, data_sh,
+                                      user_schedule=fresh_sched())
+    pend.fetch()
+    out["masked_sharded_superstep"] = {"after_warm": size1,
+                                       "after_repeat": eng_sh.program_cache_size()}
+
+    grp = GroupedRoundEngine(cfg, mesh)
+    rates_vec = np.asarray(cfg["model_rate"], np.float32)
+    g = model.init(jax.random.key(0))
+    g, _ = grp.train_round(g, fresh_idx(), rates_vec[fresh_idx()], data,
+                           fresh_lr(), jax.random.key(1))
+    size1 = grp.program_cache_size()
+    g, _ = grp.train_round(g, fresh_idx(), rates_vec[fresh_idx()], data,
+                           fresh_lr(), jax.random.key(2))
+    out["grouped_round"] = {"after_warm": size1,
+                            "after_repeat": grp.program_cache_size()}
+    return out
+
+
+def flop_budget_check(report: AuditReport, setup,
+                      level_prog_names: Dict[float, str],
+                      tol: Optional[float] = None) -> Dict[str, Any]:
+    """Measured per-level-program FLOP shares vs the analytic shares from
+    :func:`~..fed.core.level_flop_shares` (equal client counts per level in
+    the audit matrix -> uniform weights), plus strict monotonicity of the
+    measured shares in the rate."""
+    from ..fed.core import level_flop_shares
+
+    if tol is None:
+        tol = FLAGSHIP_FLOP_TOL if setup["flagship"] else SMALL_FLOP_TOL
+    rates = sorted(level_prog_names, reverse=True)
+    measured = {r: report.programs[level_prog_names[r]].flops for r in rates}
+    sec: Dict[str, Any] = {"ok": True, "tol": tol,
+                           "measured_flops": {f"{r:g}": measured[r] for r in rates}}
+    if any(measured[r] is None for r in rates):
+        report.fail(sec, "flop-budget", "cost_analysis unavailable for a "
+                    "level program; FLOP budget cannot be audited")
+        return sec
+    total = sum(measured.values())
+    analytic = level_flop_shares(setup["cfg"])
+    sec["measured_shares"] = {f"{r:g}": measured[r] / total for r in rates}
+    sec["analytic_shares"] = {f"{r:g}": analytic[r] for r in rates}
+    for r in rates:
+        ms, as_ = measured[r] / total, analytic[r]
+        rel = abs(ms - as_) / as_
+        if rel > tol:
+            report.fail(sec, "flop-budget",
+                        f"level {r:g}: measured FLOP share {ms:.4f} vs "
+                        f"analytic {as_:.4f} (rel err {rel:.3f} > tol {tol})")
+    for hi, lo in zip(rates, rates[1:]):
+        if measured[hi] <= measured[lo]:
+            report.fail(sec, "flop-monotonicity",
+                        f"level {hi:g} program FLOPs ({measured[hi]:.3e}) not "
+                        f"above level {lo:g} ({measured[lo]:.3e}): the "
+                        f"dense-per-level win has regressed")
+    return sec
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
+              seed: int = 0, with_recompile_check: bool = True) -> AuditReport:
+    """The full program audit.  Returns an :class:`AuditReport` (the CLI
+    adds lint findings and serialises to STATICCHECK.json)."""
+    report = AuditReport()
+    setup = build_setup(flagship=flagship, seed=seed)
+    report.config = {
+        "flagship": flagship,
+        "data_name": setup["cfg"]["data_name"],
+        "model_name": setup["cfg"]["model_name"],
+        "num_users": setup["users"],
+        "levels": sorted({float(r) for r in setup["cfg"]["model_rate"]},
+                         reverse=True),
+        "mesh": dict(zip(setup["mesh"].axis_names,
+                         (int(s) for s in setup["mesh"].devices.shape))),
+    }
+    mesh = setup["mesh"]
+    targets = list(_masked_targets(setup))
+    grouped, level_prog_names, _ = _grouped_targets(setup)
+    targets.extend(grouped)
+    for name, prog, args, expect in targets:
+        report.add_program(audit_program(name, prog, args, expect, mesh))
+
+    report.flop_budget = flop_budget_check(report, setup, level_prog_names,
+                                           tol=flop_tol)
+    if with_recompile_check:
+        rc = recompile_hazard_check(setup)
+        for which, sizes in list(rc.items()):
+            if isinstance(sizes, dict) and \
+                    sizes["after_repeat"] > sizes["after_warm"]:
+                report.fail(rc, "recompile-hazard",
+                            f"{which}: program cache grew "
+                            f"{sizes['after_warm']} -> {sizes['after_repeat']} "
+                            f"on a fresh-but-identical dispatch (cache-key "
+                            f"leak: weak types / python scalars / slot "
+                            f"re-bucketing)")
+        report.recompile = rc
+    return report
+
+
+def flop_account(cfg, data, mesh, user_idx, rates,
+                 params=None) -> Dict[str, Any]:
+    """Masked-vs-grouped compiled FLOP account at an explicit active mix:
+    the one implementation behind ``scripts/grouped_flops.py`` and the
+    engine-comparison numbers in MEASUREMENTS.md.  Nothing is executed --
+    programs are lowered and compiled only.  Counts are per scan-body
+    execution (XLA's cost model counts loop bodies once), which cancels in
+    every ratio/share."""
+    import jax
+
+    from ..analysis import cost_analysis_dict
+    from ..fed.core import level_flop_shares
+    from ..models import make_model
+    from ..parallel import GroupedRoundEngine, RoundEngine
+
+    model = make_model(cfg)
+    if params is None:
+        params = model.init(jax.random.key(0))
+    key, lr = jax.random.key(0), np.float32(0.1)
+    data = tuple(data)
+
+    eng = RoundEngine(model, cfg, mesh)
+    fix = (eng.fix_rates,) if eng.fix_rates is not None else ()
+    ug = np.asarray(user_idx, np.int32)
+    masked = cost_analysis_dict(
+        eng._build_train().lower(params, key, lr, ug, ug, *(data + fix))
+        .compile())["flops"]
+
+    grp = GroupedRoundEngine(cfg, mesh)
+    by: Dict[float, List[int]] = {}
+    for pos, r in enumerate(np.asarray(rates)):
+        by.setdefault(float(r), []).append(pos)
+    per_level: Dict[str, float] = {}
+    sums, cnts = [], []
+    for r in sorted(by, reverse=True):
+        u = np.asarray(ug[by[r]], np.int32)
+        prog = grp._level_prog(r, len(u))
+        per_level[f"{r:g}"] = cost_analysis_dict(
+            prog.lower(params, key, lr, u, *data).compile())["flops"]
+        # avals only (nothing executes): the combine lowering needs the
+        # level partials' shapes/dtypes, not values
+        s, c, _ = jax.eval_shape(prog, params, key, lr, u, *data)
+        sums.append(s)
+        cnts.append(c)
+    combine = cost_analysis_dict(
+        grp._combine_prog(len(sums)).lower(params, sums, cnts).compile())["flops"]
+    grouped_total = sum(per_level.values()) + combine
+    weights = {r: float(len(p)) for r, p in by.items()}
+    return {
+        "masked_flops_per_round": masked,
+        "grouped_flops_per_round": grouped_total,
+        "grouped_per_level_flops": per_level,
+        "combine_flops": combine,
+        "flop_ratio_masked_over_grouped": round(masked / grouped_total, 3),
+        "analytic_level_shares": {f"{r:g}": v for r, v in
+                                  level_flop_shares(cfg, weights).items()},
+    }

@@ -1,0 +1,164 @@
+"""Jaxpr / lowered-IR walking utilities for the program auditor.
+
+Everything here is *static*: programs are traced/lowered/compiled but never
+executed.  The walkers recurse through every sub-jaxpr (scan/while bodies,
+cond/switch branches, shard_map and custom-derivative bodies), so an op
+smuggled inside a ``lax.scan`` round body is found exactly like a top-level
+one.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Iterable, List, Set, Tuple
+
+import jax
+
+#: primitive names that call back into the host (banned in round programs:
+#: one callback serialises the whole fused round on the host boundary)
+CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback")
+
+#: collective primitives whose axis names must resolve in the mesh
+COLLECTIVE_PRIMITIVES = ("psum", "all_gather", "all_to_all", "ppermute",
+                        "pmax", "pmin", "reduce_scatter")
+
+
+def _sub_jaxprs(params: dict) -> Iterable[Any]:
+    for v in params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vs:
+            if isinstance(item, jax.core.ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, jax.core.Jaxpr):
+                yield item
+
+
+def iter_eqns(jaxpr) -> Iterable[Any]:
+    """Yield every eqn of ``jaxpr`` (a ``Jaxpr`` or ``ClosedJaxpr``),
+    recursing into all sub-jaxprs."""
+    if isinstance(jaxpr, jax.core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def provenance(eqn) -> str:
+    """``file:line (fn)`` of the python frame that bound the op, best
+    effort -- the loud half of a callback/f64 finding."""
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:
+        return "<unknown provenance>"
+
+
+def primitive_counts(jaxpr) -> Counter:
+    return Counter(eqn.primitive.name for eqn in iter_eqns(jaxpr))
+
+
+def find_callbacks(jaxpr) -> List[Tuple[str, str]]:
+    """(primitive name, provenance) of every host-callback op."""
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        if any(eqn.primitive.name.startswith(p) for p in CALLBACK_PRIMITIVES):
+            out.append((eqn.primitive.name, provenance(eqn)))
+    return out
+
+
+def find_f64(jaxpr) -> List[Tuple[str, str]]:
+    """(description, provenance) of every float64 value or convert: a silent
+    f64 in a round program doubles its bandwidth/footprint (and on TPU
+    deoptimises to software emulation)."""
+    import numpy as np
+
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        nd = eqn.params.get("new_dtype")
+        if eqn.primitive.name == "convert_element_type" and nd == np.float64:
+            out.append((f"convert_element_type -> float64", provenance(eqn)))
+            continue
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if getattr(aval, "dtype", None) == np.float64:
+                out.append((f"{eqn.primitive.name} produces float64 "
+                            f"{getattr(aval, 'shape', ())}", provenance(eqn)))
+                break
+    return out
+
+
+def collective_axes(eqn) -> Tuple[str, ...]:
+    """Flattened axis names a collective eqn operates over."""
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    flat = []
+    for a in axes:
+        if isinstance(a, (tuple, list)):
+            flat.extend(a)
+        else:
+            flat.append(a)
+    return tuple(str(a) for a in flat if isinstance(a, (str,)) or a is not None)
+
+
+def count_collectives(jaxpr) -> Tuple[Counter, Set[str]]:
+    """(per-primitive bind counts, all axis names seen).  A ``psum`` over
+    ``(sums, counts)`` is ONE bind -- the budget the engines are audited
+    against counts collective launches, not leaves."""
+    counts: Counter = Counter()
+    axes: Set[str] = set()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if any(name == p or name.startswith(p + "_") for p in COLLECTIVE_PRIMITIVES):
+            counts[name] += 1
+            axes.update(collective_axes(eqn))
+    return counts, axes
+
+
+def count_psum_over(jaxpr, axis: str = "clients") -> int:
+    """psum binds whose axes include ``axis`` (the global-collective
+    budget; a data-axis psum inside intra-client DP is not a global one)."""
+    n = 0
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name == "psum" and axis in collective_axes(eqn):
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# donation / aliasing, from the lowered & compiled IR text
+# ---------------------------------------------------------------------------
+
+def donation_marks(lowered_text: str) -> int:
+    """Donated input tensors at lowering: ``jax.buffer_donor`` (donation
+    deferred to XLA) + ``tf.aliasing_output`` (aliasing already pinned)."""
+    return lowered_text.count("jax.buffer_donor") + \
+        lowered_text.count("tf.aliasing_output")
+
+
+def aliased_outputs(compiled_text: str) -> int:
+    """Input-output alias pairs the compiled executable actually
+    established -- donation that CONSUMED a buffer, not just permission.
+
+    Parsed from the optimized ``HloModule`` header, which lists one
+    ``{out_index}: (param, {}, may-alias)`` entry per aliased tensor inside
+    ``input_output_alias={ ... }`` (brace-balanced scan: the entries
+    themselves contain ``{}`` sub-indices)."""
+    start = compiled_text.find("input_output_alias={")
+    if start < 0:
+        return 0
+    i = compiled_text.index("{", start)
+    depth, j = 0, i
+    for j in range(i, min(len(compiled_text), i + 1_000_000)):
+        c = compiled_text[j]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    block = compiled_text[i:j + 1]
+    return block.count("may-alias") + block.count("must-alias")

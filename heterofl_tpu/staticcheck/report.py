@@ -1,0 +1,103 @@
+"""Finding/report containers shared by the lint and audit fronts.
+
+Kept jax-free: the lint front and the CLI's report plumbing must import
+without booting a JAX backend (the CLI scrubs the TPU-tunnel env hooks
+before jax loads).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class Finding:
+    """One violation.  ``where`` is ``path:line`` for lint findings and the
+    program name (plus op provenance when known) for audit findings."""
+
+    rule: str
+    where: str
+    message: str
+
+    def __str__(self) -> str:  # `path:line: [rule] message` -- grep-friendly
+        return f"{self.where}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class ProgramReport:
+    """Audit result for one lowered/compiled program."""
+
+    name: str
+    ok: bool = True
+    findings: List[Finding] = field(default_factory=list)
+    #: psum binds over the ``clients`` axis (the global-collective budget)
+    psum_clients: int = 0
+    all_gather: int = 0
+    #: collective axis names seen in the program
+    collective_axes: List[str] = field(default_factory=list)
+    #: donation: leaves marked for donation at lowering / consumed by
+    #: input-output aliasing in the optimized HLO / expected count
+    donated: int = 0
+    aliased: int = 0
+    donation_expected: int = 0
+    flops: Optional[float] = None
+    memory: Optional[Dict[str, int]] = None
+
+    def fail(self, rule: str, message: str) -> None:
+        self.ok = False
+        self.findings.append(Finding(rule, self.name, message))
+
+
+@dataclass
+class AuditReport:
+    """The whole staticcheck run: lint findings + per-program audits +
+    cross-program checks, serialisable to STATICCHECK.json."""
+
+    ok: bool = True
+    config: Dict[str, Any] = field(default_factory=dict)
+    programs: Dict[str, ProgramReport] = field(default_factory=dict)
+    flop_budget: Dict[str, Any] = field(default_factory=dict)
+    recompile: Dict[str, Any] = field(default_factory=dict)
+    lint: List[Finding] = field(default_factory=list)
+    generated_at: Optional[str] = None
+
+    def add_program(self, prog: ProgramReport) -> None:
+        self.programs[prog.name] = prog
+        self.ok = self.ok and prog.ok
+
+    def add_lint(self, findings: List[Finding]) -> None:
+        self.lint.extend(findings)
+        self.ok = self.ok and not findings
+
+    def fail(self, section: Dict[str, Any], rule: str, message: str) -> None:
+        """Record a cross-program failure in ``section`` (flop_budget /
+        recompile) and flip the report."""
+        self.ok = False
+        section.setdefault("findings", []).append(
+            asdict(Finding(rule, "audit", message)))
+        section["ok"] = False
+
+    def all_findings(self) -> List[Finding]:
+        out = list(self.lint)
+        for p in self.programs.values():
+            out.extend(p.findings)
+        for sec in (self.flop_budget, self.recompile):
+            out.extend(Finding(**f) for f in sec.get("findings", []))
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": 1,
+            "ok": self.ok,
+            "generated_at": self.generated_at,
+            "config": self.config,
+            "programs": {k: asdict(v) for k, v in self.programs.items()},
+            "flop_budget": self.flop_budget,
+            "recompile": self.recompile,
+            "lint": [asdict(f) for f in self.lint],
+        }
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)

@@ -1,0 +1,271 @@
+"""Front 2: path-scoped AST lint rules over the package source.
+
+Each rule bans a set of calls in a set of path prefixes; a finding on a
+given line is suppressed by a ``# staticcheck: allow(<rule-id>)`` pragma on
+any line the offending call spans (put the reason after the pragma -- the
+pragma is the machine-readable half, the comment the human half).
+
+The rules guard the zero-resharding / zero-host-tax contract of the round
+engines (PR 1/PR 2): in ``parallel/`` steady-state code, device arrays must
+be produced by the explicit staging layer, not per-call ``asarray`` wraps;
+nothing on the round path may synchronise (``block_until_ready``,
+``device_get``, ``float()`` on device values); traced scopes must not reach
+wall clocks or fresh-seeded RNG (cache-key and determinism hazards); and
+every ``jax.jit`` must take an explicit donation stance.
+
+Pure AST + stdlib: no jax import, so the lint front runs in milliseconds
+and anywhere (pre-commit, the CLI's ``--skip-audit`` mode, the test gate).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .report import Finding
+
+PRAGMA_RE = re.compile(r"#\s*staticcheck:\s*allow\(([A-Za-z0-9_,\- ]+)\)")
+
+#: modules whose plain ``import x`` already binds the canonical name
+_CANONICAL_ROOTS = ("jax", "numpy", "time", "random")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One banned-call rule.
+
+    ``calls``: canonical dotted names (``numpy.asarray``, ``time.time``);
+    ``methods``: attribute names banned as method calls on ANY receiver
+    (``block_until_ready``); ``builtins``: bare builtin calls (``float``);
+    ``require_kwargs``: when set, ``calls`` are not banned outright but must
+    pass at least one of these keywords (the ``jax.jit`` donation rule).
+    ``paths``: repo-relative path prefixes the rule applies to.
+    """
+
+    id: str
+    description: str
+    paths: Tuple[str, ...]
+    calls: Tuple[str, ...] = ()
+    methods: Tuple[str, ...] = ()
+    builtins: Tuple[str, ...] = ()
+    require_kwargs: Tuple[str, ...] = ()
+
+    def applies_to(self, relpath: str) -> bool:
+        rp = relpath.replace(os.sep, "/")
+        return any(rp.startswith(p) or f"/{p}" in rp for p in self.paths)
+
+
+_PARALLEL = ("heterofl_tpu/parallel/",)
+_TRACED = ("heterofl_tpu/parallel/", "heterofl_tpu/fed/")
+
+DEFAULT_RULES: Tuple[Rule, ...] = (
+    Rule("no-asarray",
+         "per-call asarray device/host wraps in steady-state code: commit "
+         "operands once via the staging layer (PlacementCache) instead",
+         _PARALLEL,
+         calls=("jax.numpy.asarray", "numpy.asarray")),
+    Rule("no-block-until-ready",
+         "host synchronisation on the round path: only the bench/driver "
+         "boundary may block",
+         _PARALLEL,
+         calls=("jax.block_until_ready",),
+         methods=("block_until_ready",)),
+    Rule("no-device-get",
+         "implicit D2H on the round path: metric sums stay on device "
+         "(PendingMetrics) until the caller fetches",
+         _PARALLEL,
+         calls=("jax.device_get",),
+         methods=("device_get",)),
+    Rule("no-float-coercion",
+         "float() on a device value blocks on the transfer; fetch through "
+         "PendingMetrics / eval boundaries instead",
+         _PARALLEL,
+         builtins=("float",)),
+    Rule("no-wallclock",
+         "wall-clock reads reachable from traced scopes poison program "
+         "purity (and silently constant-fold at trace time)",
+         _TRACED,
+         calls=("time.time", "time.perf_counter", "time.monotonic",
+                "time.time_ns", "time.perf_counter_ns")),
+    Rule("no-fresh-rng",
+         "fresh-seeded host RNG in engine code breaks the reproducible "
+         "PRNG-stream contract (fed.core.round_rates/round_users own the "
+         "streams)",
+         _TRACED,
+         calls=("numpy.random.default_rng", "numpy.random.seed",
+                "numpy.random.RandomState", "random.seed", "random.random",
+                "random.randint")),
+    Rule("jit-needs-donation",
+         "every jax.jit in the round path must take an explicit donation "
+         "stance (donate_argnums/donate_argnames), or carry an allow pragma "
+         "saying why buffers must survive",
+         _PARALLEL,
+         calls=("jax.jit",),
+         require_kwargs=("donate_argnums", "donate_argnames")),
+)
+
+
+def _collect_pragmas(src: str) -> Dict[int, Set[str]]:
+    """line number -> set of allowed rule ids.
+
+    A pragma covers its own line; a pragma inside a standalone comment
+    block also covers the statement line the block precedes (so a
+    multi-line reason can sit above the call it licenses)."""
+    lines = src.splitlines()
+    out: Dict[int, Set[str]] = {}
+
+    def add(i: int, ids: Set[str]) -> None:
+        out.setdefault(i, set()).update(ids)
+
+    for i, line in enumerate(lines, start=1):
+        m = PRAGMA_RE.search(line)
+        if not m:
+            continue
+        ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+        add(i, ids)
+        if line.lstrip().startswith("#"):
+            j = i + 1
+            while j <= len(lines) and lines[j - 1].lstrip().startswith("#"):
+                add(j, ids)
+                j += 1
+            if j <= len(lines):
+                add(j, ids)
+    return out
+
+
+def _alias_map(tree: ast.AST) -> Dict[str, str]:
+    """local name -> canonical dotted prefix (``jnp`` -> ``jax.numpy``,
+    ``time`` (from-import of ``time.time``) -> ``time.time``)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] in _CANONICAL_ROOTS:
+                    aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.split(".")[0] in _CANONICAL_ROOTS:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _qualname(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of a Name/Attribute chain, or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    base = aliases.get(node.id, node.id)
+    parts.append(base)
+    return ".".join(reversed(parts))
+
+
+def _node_lines(node: ast.AST) -> Iterable[int]:
+    lo = getattr(node, "lineno", None)
+    if lo is None:
+        return ()
+    hi = getattr(node, "end_lineno", None) or lo
+    return range(lo, hi + 1)
+
+
+def _suppressed(node: ast.AST, rule_id: str, pragmas: Dict[int, Set[str]]) -> bool:
+    return any(rule_id in pragmas.get(ln, ()) for ln in _node_lines(node))
+
+
+def lint_source(src: str, relpath: str,
+                rules: Sequence[Rule] = DEFAULT_RULES) -> List[Finding]:
+    """Lint one file's source.  ``relpath`` decides which rules apply."""
+    active = [r for r in rules if r.applies_to(relpath)]
+    if not active:
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("syntax-error", f"{relpath}:{e.lineno or 0}", str(e))]
+    aliases = _alias_map(tree)
+    pragmas = _collect_pragmas(src)
+    findings: List[Finding] = []
+
+    def report(rule: Rule, node: ast.AST, what: str) -> None:
+        if _suppressed(node, rule.id, pragmas):
+            return
+        findings.append(Finding(
+            rule.id, f"{relpath}:{node.lineno}",
+            f"{what}: {rule.description}"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            qn = _qualname(node.func, aliases)
+            for rule in active:
+                if rule.require_kwargs:
+                    if qn in rule.calls and not any(
+                            kw.arg in rule.require_kwargs for kw in node.keywords):
+                        report(rule, node, f"{qn}(...) without "
+                               f"{'/'.join(rule.require_kwargs)}")
+                    continue
+                if qn is not None and qn in rule.calls:
+                    report(rule, node, f"call to {qn}")
+                elif rule.methods and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in rule.methods:
+                    report(rule, node, f"method call .{node.func.attr}()")
+                elif rule.builtins and isinstance(node.func, ast.Name) \
+                        and node.func.id in rule.builtins:
+                    report(rule, node, f"builtin {node.func.id}() coercion")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a bare @jax.jit decorator takes no donation stance either
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                qn = _qualname(target, aliases)
+                for rule in active:
+                    if rule.require_kwargs and qn in rule.calls \
+                            and not isinstance(dec, ast.Call):
+                        report(rule, dec, f"bare @{qn} decorator without "
+                               f"{'/'.join(rule.require_kwargs)}")
+    return findings
+
+
+def lint_paths(files: Iterable[Tuple[str, str]],
+               rules: Sequence[Rule] = DEFAULT_RULES) -> List[Finding]:
+    """Lint ``(relpath, source)`` pairs."""
+    out: List[Finding] = []
+    for relpath, src in files:
+        out.extend(lint_source(src, relpath, rules))
+    return out
+
+
+def lint_tree(root: str, rules: Sequence[Rule] = DEFAULT_RULES,
+              subdirs: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Walk ``root`` (a repo checkout or any directory laid out like one)
+    and lint every ``.py`` file under it.  ``subdirs`` restricts the walk.
+
+    Relpaths are prefixed with ``root``'s own directory name so the rule
+    path scopes resolve even when ``root`` points INSIDE the layout (e.g.
+    ``--lint-root heterofl_tpu`` yields ``heterofl_tpu/parallel/...``, not
+    the scope-defeating ``parallel/...``)."""
+    pairs: List[Tuple[str, str]] = []
+    findings: List[Finding] = []
+    prefix = os.path.basename(os.path.abspath(root))
+    roots = [os.path.join(root, s) for s in subdirs] if subdirs else [root]
+    for base in roots:
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in (".git", "__pycache__", ".jax_cache")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.join(prefix, os.path.relpath(full, root))
+                try:
+                    with open(full, encoding="utf-8") as f:
+                        pairs.append((rel, f.read()))
+                except OSError as e:
+                    # unreadable source IS a finding: the gate must not
+                    # silently skip files (and must keep the rest's findings)
+                    findings.append(Finding("unreadable", rel, str(e)))
+    return findings + lint_paths(pairs, rules)

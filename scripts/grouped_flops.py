@@ -4,12 +4,13 @@ flagship config (VERDICT r4 item 1 'done' bar).
 
 The round-4 roofline (MEASUREMENTS.md) derived ~72.7 TFLOP/round for the
 masked strategy vs ~18.6 for ideal dense per-level execution analytically;
-this script asks XLA itself: lower + compile both engines' round programs at
-the BASELINE.json config (CIFAR10 ResNet-18, hidden [64,128,256,512],
-100 users, 10 active, a1-b1-c1-d1-e1 -> 2 clients per level) and report
-``compile().cost_analysis()`` FLOPs.  CPU-safe: nothing is executed, only
-compiled.  Prints one JSON line; run under JAX_PLATFORMS=cpu with the axon
-env scrubbed (see tests/conftest.py).
+this script asks XLA itself via :func:`heterofl_tpu.staticcheck.audit.
+flop_account` -- the SAME implementation the staticcheck FLOP-budget audit
+runs, so there is one source of truth for the level FLOP numbers (the
+analytic shares come from ``fed.core.level_flop_shares``, which also drives
+the grouped engine's slices row allocation).  CPU-safe: nothing is
+executed, only compiled.  Prints one JSON line; run under
+JAX_PLATFORMS=cpu with the axon env scrubbed (see tests/conftest.py).
 
 Usage: [SMALL=1] python scripts/grouped_flops.py   (SMALL=1: test widths)
 """
@@ -21,15 +22,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from heterofl_tpu import config as C
 from heterofl_tpu.data import fetch_dataset, label_split_masks, split_dataset, stack_client_shards
-from heterofl_tpu.models import make_model
-from heterofl_tpu.analysis import cost_analysis_dict as _ca_dict
-from heterofl_tpu.parallel import GroupedRoundEngine, RoundEngine, make_mesh
+from heterofl_tpu.parallel import make_mesh
+from heterofl_tpu.staticcheck.audit import flop_account
 
 
 def main():
@@ -51,11 +49,8 @@ def main():
     x, y, m = stack_client_shards(ds["train"].data, ds["train"].target,
                                   split["train"], list(range(users)))
     lm = label_split_masks(lsplit, users, 10)
-    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
-    model = make_model(cfg)
-    params = model.init(jax.random.key(0))
+    data = (x, y, m, lm)
     mesh = make_mesh(1, 1)
-    key, lr = jax.random.key(0), jnp.float32(0.1)
 
     # active set: the expected mix, 2 clients per level (fix-mode rate vector
     # is level-blocked: users [0..U/5) are level a, etc.)
@@ -64,50 +59,15 @@ def main():
     for r in sorted(set(rates_vec), reverse=True):
         user_idx += list(np.where(rates_vec == r)[0][:2])
     user_idx = np.asarray(user_idx, np.int32)
-    rates = rates_vec[user_idx]
 
-    eng = RoundEngine(model, cfg, mesh)
-    if eng._train is None:
-        eng._train = eng._build_train()
-    ug = jnp.asarray(user_idx)
-    args = tuple(data) + ((jnp.asarray(eng.fix_rates),) if eng.fix_rates is not None else ())
     t0 = time.time()
-    masked = _ca_dict(eng._train.lower(params, key, lr, ug, ug, *args).compile())
-    t_masked = time.time() - t0
-    print(f"masked compiled in {t_masked:.0f}s: {masked['flops']:.3e} flops",
-          file=sys.stderr, flush=True)
-
-    grp = GroupedRoundEngine(cfg, mesh)
-    by = {}
-    for pos, r in enumerate(rates):
-        by.setdefault(float(r), []).append(pos)
-    per_level = {}
-    sums, cnts = [], []
-    t0 = time.time()
-    for r in sorted(by, reverse=True):
-        u = jnp.asarray(user_idx[by[r]])
-        prog = grp._level_prog(r, len(by[r]))
-        ca = _ca_dict(prog.lower(params, key, lr, u, *data).compile())
-        per_level[str(r)] = ca["flops"]
-        print(f"level {r}: {ca['flops']:.3e} flops", file=sys.stderr, flush=True)
-        # avals only (keeps the 'nothing is executed' contract): the combine
-        # lowering needs shapes/dtypes of the level partials, not values
-        s, c, _ = jax.eval_shape(prog, params, key, lr, u, *data)
-        sums.append(s)
-        cnts.append(c)
-    combine = _ca_dict(grp._combine_prog(len(sums)).lower(params, sums, cnts).compile())
-    t_grouped = time.time() - t0
-    grouped_total = sum(per_level.values()) + combine["flops"]
+    account = flop_account(cfg, data, mesh, user_idx, rates_vec[user_idx])
     print(json.dumps({
         "config": f"CIFAR10 resnet18 {cfg['resnet']['hidden_size']} "
                   f"{users}u/10a a1-e1, batch {cfg['batch_size']['train']}, "
                   f"local_epochs {cfg['num_epochs']['local']}, bf16",
-        "masked_flops_per_round": masked["flops"],
-        "grouped_flops_per_round": grouped_total,
-        "grouped_per_level_flops": per_level,
-        "combine_flops": combine["flops"],
-        "flop_ratio_masked_over_grouped": round(masked["flops"] / grouped_total, 3),
-        "compile_sec": {"masked": round(t_masked, 1), "grouped": round(t_grouped, 1)},
+        **account,
+        "compile_sec": round(time.time() - t0, 1),
     }), flush=True)
 
 

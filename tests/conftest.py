@@ -46,6 +46,17 @@ if not os.path.isdir(jax.config.jax_compilation_cache_dir):
         f"persistent compile cache dir {jax.config.jax_compilation_cache_dir!r} "
         f"does not exist")
 
+import warnings  # noqa: E402
+
+# JAX donation warnings are ERRORS in the gate (ISSUE 3 satellite): a
+# "donated buffers were not usable" warning means a program claims donation
+# it cannot honour -- silent memory doubling on the round path.  pytest.ini
+# carries the matching filterwarnings entries for pytest runs; these module
+# filters cover bare/in-process harnesses that import this conftest.  The
+# staticcheck auditor additionally promotes them to audit failures.
+warnings.filterwarnings("error", message="Some donated buffers were not usable")
+warnings.filterwarnings("error", message="Donation is not implemented")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

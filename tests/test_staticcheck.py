@@ -1,0 +1,400 @@
+"""staticcheck (ISSUE 3): the AST lint rules (positive / pragma-suppressed /
+path-scoped), the jaxpr walkers, and the full program-audit matrix -- the
+tier-1 gate that every engine variant keeps its compiled-program contract:
+no host callbacks or f64, full donation coverage, exactly one global psum
+per fused round, no recompile on fresh-but-identical inputs, and the
+level-table FLOP budget."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heterofl_tpu.staticcheck import audit as audit_mod
+from heterofl_tpu.staticcheck.audit import (audit_program, build_setup,
+                                            run_audit, _masked_targets)
+from heterofl_tpu.staticcheck.jaxpr_walk import (count_psum_over,
+                                                find_callbacks, find_f64)
+from heterofl_tpu.staticcheck.rules import lint_source, lint_tree
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IN_SCOPE = "heterofl_tpu/parallel/somefile.py"
+
+
+# ---------------------------------------------------------------------------
+# front 2: AST lint rules
+# ---------------------------------------------------------------------------
+
+def _lint(src, relpath=IN_SCOPE):
+    return lint_source(textwrap.dedent(src), relpath)
+
+
+def test_banned_asarray_flagged_and_pragma_suppressed():
+    src = """
+    import numpy as np
+    def f(a):
+        return np.asarray(a)
+    """
+    fs = _lint(src)
+    assert [f.rule for f in fs] == ["no-asarray"]
+    assert fs[0].where == f"{IN_SCOPE}:4"
+    # same-line pragma
+    assert _lint("""
+    import numpy as np
+    def f(a):
+        return np.asarray(a)  # staticcheck: allow(no-asarray): reason
+    """) == []
+    # preceding-comment-block pragma (multi-line reason style)
+    assert _lint("""
+    import numpy as np
+    def f(a):
+        # staticcheck: allow(no-asarray): a longer reason that
+        # spans two comment lines before the call it licenses
+        return np.asarray(a)
+    """) == []
+
+
+def test_pragma_is_rule_scoped():
+    """A pragma for one rule must not silence another on the same line."""
+    fs = _lint("""
+    import numpy as np
+    def f(a):
+        return float(np.asarray(a))  # staticcheck: allow(no-asarray)
+    """)
+    assert [f.rule for f in fs] == ["no-float-coercion"]
+
+
+def test_path_scoping():
+    src = """
+    import numpy as np
+    def f(a):
+        return np.asarray(a)
+    """
+    assert _lint(src, "heterofl_tpu/models/conv.py") == []
+    assert _lint(src, "heterofl_tpu/analysis/summary.py") == []
+    assert len(_lint(src, "heterofl_tpu/parallel/engine.py")) == 1
+    # nested checkouts still match (prefix anywhere after a slash)
+    assert len(_lint(src, "work/heterofl_tpu/parallel/engine.py")) == 1
+
+
+def test_alias_resolution_variants():
+    flagged = _lint("""
+    from jax import numpy as weird
+    def f(a):
+        return weird.asarray(a)
+    """)
+    assert [f.rule for f in flagged] == ["no-asarray"]
+    flagged = _lint("""
+    import jax.numpy as jnp
+    def f(a):
+        return jnp.asarray(a)
+    """)
+    assert [f.rule for f in flagged] == ["no-asarray"]
+
+
+def test_wallclock_and_fresh_rng_scoped_to_fed_too():
+    src = """
+    import time
+    import numpy as np
+    def f():
+        t = time.perf_counter()
+        g = np.random.default_rng()
+        return t, g
+    """
+    rules_hit = sorted(f.rule for f in _lint(src, "heterofl_tpu/fed/core.py"))
+    assert rules_hit == ["no-fresh-rng", "no-wallclock"]
+    assert _lint(src, "heterofl_tpu/data/pipeline.py") == []
+
+
+def test_block_until_ready_method_call():
+    fs = _lint("""
+    def f(x):
+        return x.block_until_ready()
+    """)
+    assert [f.rule for f in fs] == ["no-block-until-ready"]
+
+
+def test_jit_donation_rule():
+    base = """
+    import jax
+    def mk(f):
+        return jax.jit(f{})
+    """
+    assert [f.rule for f in _lint(base.format(""))] == ["jit-needs-donation"]
+    assert _lint(base.format(", donate_argnums=(0,)")) == []
+    assert _lint(base.format(", donate_argnames='params'")) == []
+    # an explicit empty donation IS a stance (the span-mode level programs)
+    assert _lint(base.format(", donate_argnums=()")) == []
+    # a bare decorator takes no stance either
+    fs = _lint("""
+    import jax
+    @jax.jit
+    def f(x):
+        return x
+    """)
+    assert [f.rule for f in fs] == ["jit-needs-donation"]
+
+
+def test_repo_tree_is_lint_clean():
+    """The gate itself: the shipped tree has zero unsuppressed findings."""
+    fs = lint_tree(REPO, subdirs=["heterofl_tpu"])
+    assert fs == [], "\n".join(str(f) for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# front 1: jaxpr walkers
+# ---------------------------------------------------------------------------
+
+def test_find_callbacks_inside_scan_body():
+    """An op smuggled inside a lax.scan round body is found like a
+    top-level one, with provenance."""
+    def step(c, _):
+        jax.debug.callback(lambda v: None, c)
+        return c + 1.0, None
+
+    def f(x):
+        out, _ = jax.lax.scan(step, x, None, length=3)
+        return out
+
+    hits = find_callbacks(jax.jit(f).trace(np.float32(0.0)).jaxpr)
+    assert len(hits) == 1
+    name, prov = hits[0]
+    assert name == "debug_callback"
+    assert "test_staticcheck" in prov
+
+
+def test_find_f64():
+    with jax.experimental.enable_x64():
+        jaxpr = jax.make_jaxpr(lambda x: x.astype(jnp.float64) * 2.0)(
+            np.ones(3, np.float32))
+    hits = find_f64(jaxpr)
+    assert hits and "float64" in hits[0][0]
+
+
+def test_count_psum_binds_not_leaves():
+    """One psum bind over a (sums, counts) tuple is ONE collective launch
+    -- the budget the fused round is audited against."""
+    def f2(a, b):
+        return jax.lax.psum((a, b), "clients")
+
+    def f1(a, b):
+        return jax.lax.psum(a, "clients"), jax.lax.psum(b, "clients")
+
+    import functools
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("clients", "data"))
+    sm = functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P("clients"), P("clients")),
+                           out_specs=P(), check_rep=False)
+    x = np.ones((4, 2), np.float32)
+    assert count_psum_over(jax.jit(sm(f2)).trace(x, x).jaxpr) == 1
+    assert count_psum_over(jax.jit(sm(f1)).trace(x, x).jaxpr) == 2
+
+
+# ---------------------------------------------------------------------------
+# the program-audit matrix (the tier-1 gate for the engines)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def audit_report():
+    return run_audit()
+
+
+def test_audit_matrix_is_green(audit_report):
+    assert audit_report.ok, "\n".join(str(f) for f in audit_report.all_findings())
+
+
+def test_fused_superstep_single_global_psum(audit_report):
+    """The PR 2 invariant, now statically enforced: the grouped fused round
+    (both placements) performs exactly ONE global psum."""
+    for name in ("grouped/span/k8-fused", "grouped/slices/k8-fused"):
+        p = audit_report.programs[name]
+        assert p.psum_clients == 1, name
+        assert p.all_gather == 0, name
+        assert set(p.collective_axes) <= {"clients", "data"}, name
+
+
+def test_donation_coverage_both_engines_both_placements(audit_report):
+    """Every program that carries the params donates ALL param leaves and
+    every donated leaf is consumed by input-output aliasing."""
+    donating = ["masked/replicated/k1", "masked/replicated/k8",
+                "masked/sharded/k1", "masked/sharded/k8",
+                "grouped/span/combine", "grouped/span/k8-fused",
+                "grouped/slices/k8-fused"]
+    for name in donating:
+        p = audit_report.programs[name]
+        assert p.donation_expected > 0, name
+        assert p.donated == p.donation_expected, (name, p.donated)
+        assert p.aliased == p.donation_expected, (name, p.aliased)
+
+
+def test_recompile_hazard_flat(audit_report):
+    rc = audit_report.recompile
+    assert rc["ok"], rc
+    for which in ("masked_round", "masked_superstep",
+                  "masked_sharded_superstep", "grouped_round"):
+        assert rc[which]["after_repeat"] == rc[which]["after_warm"], (which, rc)
+
+
+def test_flop_budget_and_artifact_roundtrip(audit_report):
+    fb = audit_report.flop_budget
+    assert fb["ok"], fb
+    meas = fb["measured_flops"]
+    rates = sorted((float(r) for r in meas), reverse=True)
+    # strictly decreasing with the level rate: the dense-per-level win
+    for hi, lo in zip(rates, rates[1:]):
+        assert meas[f"{hi:g}"] > meas[f"{lo:g}"]
+    # the artifact serialises and carries per-program memory bytes
+    rec = json.loads(audit_report.to_json())
+    assert rec["ok"] is True and rec["version"] == 1
+    mem = rec["programs"]["masked/replicated/k1"]["memory"]
+    assert mem and mem["temp_size_in_bytes"] > 0
+
+
+def test_auditor_flags_smuggled_io_callback(monkeypatch):
+    """End-to-end seeded violation: an io_callback smuggled into the round
+    body makes the auditor fail loudly, naming the op AND where it was
+    bound."""
+    from jax.experimental import io_callback
+
+    from heterofl_tpu.parallel.round_engine import RoundEngine
+
+    orig = RoundEngine._round_core
+
+    def smuggled(self, params, key, lr, user_loc, user_glob, data):
+        new_p, ms = orig(self, params, key, lr, user_loc, user_glob, data)
+        # the smuggled host hook (e.g. a sneaky metrics push); the result is
+        # discarded but the bind stays in the jaxpr, where the walk finds it
+        _ = io_callback(lambda v: np.float32(0.0),
+                        jax.ShapeDtypeStruct((), np.float32), lr)
+        return new_p, ms
+
+    monkeypatch.setattr(RoundEngine, "_round_core", smuggled)
+    setup = build_setup()
+    name, prog, args, expect = _masked_targets(setup)[0]
+    rep = audit_program(name, prog, args, expect, setup["mesh"])
+    assert not rep.ok
+    hits = [f for f in rep.findings if f.rule == "no-host-callback"]
+    assert hits, rep.findings
+    assert "io_callback" in hits[0].message
+    assert "test_staticcheck" in hits[0].message  # provenance of the bind
+
+
+def test_auditor_flags_lost_donation():
+    """Seeded donation regression: a program that stopped donating its
+    params (here: a span-mode level program, which donates nothing by
+    design) trips both donation checks when held to the donating
+    programs' expectation."""
+    from heterofl_tpu.staticcheck.audit import _grouped_targets
+
+    setup = build_setup()
+    grouped, _names, _ = _grouped_targets(setup)
+    name, prog, args, expect = grouped[0]  # span level prog: donates 0
+    assert expect["donated"] == 0
+    bad_expect = dict(expect,
+                      donated=len(jax.tree_util.tree_leaves(setup["params"])))
+    rep = audit_program(name, prog, args, bad_expect, setup["mesh"])
+    rules = {f.rule for f in rep.findings}
+    assert "donation-coverage" in rules and "donation-consumed" in rules, \
+        rep.findings
+
+
+# ---------------------------------------------------------------------------
+# donation warnings are errors now (conftest/pytest.ini satellite)
+# ---------------------------------------------------------------------------
+
+def test_unused_donation_warning_is_error():
+    """'donated buffer unused' can never land silently again: the warning is
+    promoted to an error by the test-gate filters."""
+    # both inputs are used, both donated, but the single output can consume
+    # only one buffer -- the other donation is unusable and must raise
+    f = jax.jit(lambda x, y: x + y, donate_argnums=(0, 1))
+    with pytest.raises(UserWarning, match="donated buffers were not usable"):
+        out = f(jnp.ones((4, 4)), jnp.ones((4, 4)))
+        jax.block_until_ready(out)
+
+
+# ---------------------------------------------------------------------------
+# the CLI
+# ---------------------------------------------------------------------------
+
+def _run_cli(extra_args, tmp_path, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "heterofl_tpu.staticcheck", "--json",
+         "--out", str(tmp_path / "STATICCHECK.json")] + extra_args,
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+
+
+def test_cli_exits_nonzero_on_seeded_lint_violation(tmp_path):
+    bad = tmp_path / "tree" / "heterofl_tpu" / "parallel"
+    bad.mkdir(parents=True)
+    (bad / "bad.py").write_text(
+        "import numpy as np\n\ndef f(a):\n    return np.asarray(a)\n")
+    res = _run_cli(["--skip-audit", "--lint-root", str(tmp_path / "tree"),
+                    "--no-artifact"], tmp_path)
+    assert res.returncode == 1, res.stderr
+    rec = json.loads(res.stdout)
+    assert rec["ok"] is False
+    assert [f["rule"] for f in rec["lint"]] == ["no-asarray"]
+    # and the same invocation on a clean tree exits 0
+    good = tmp_path / "clean" / "heterofl_tpu" / "parallel"
+    good.mkdir(parents=True)
+    (good / "ok.py").write_text("def f(a):\n    return a\n")
+    res = _run_cli(["--skip-audit", "--lint-root", str(tmp_path / "clean"),
+                    "--no-artifact"], tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_bench_refuses_failing_audit_artifact():
+    """bench.py must not record a run against a tree whose program audit
+    failed: with a failing STATICCHECK.json it emits one refusal line
+    (value 0.0, vs_baseline null) and never claims devices."""
+    path = os.path.join(REPO, "STATICCHECK.json")
+    saved = None
+    if os.path.exists(path):
+        with open(path) as f:
+            saved = f.read()
+    try:
+        with open(path, "w") as f:
+            json.dump({"ok": False, "programs": {}, "lint": []}, f)
+        env = dict(os.environ, BENCH_CPU="1")
+        res = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                             env=env, capture_output=True, text=True,
+                             timeout=300, cwd=REPO)
+        rec = json.loads(res.stdout.strip().splitlines()[-1])
+        assert rec["value"] == 0.0 and rec["vs_baseline"] is None
+        assert "refusing" in rec["extra"]["error"]
+        assert rec["extra"]["staticcheck"]["ok"] is False
+    finally:
+        if saved is None:
+            os.remove(path)
+        else:
+            with open(path, "w") as f:
+                f.write(saved)
+
+
+@pytest.mark.slow
+def test_cli_full_audit_green_and_writes_artifact(tmp_path):
+    """`python -m heterofl_tpu.staticcheck --json` exits 0 on the repo and
+    the artifact asserts the acceptance invariants."""
+    env_extra = {}
+    if jax.config.jax_compilation_cache_dir:
+        env_extra["JAX_COMPILATION_CACHE_DIR"] = jax.config.jax_compilation_cache_dir
+    res = _run_cli([], tmp_path, env_extra=env_extra)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    rec = json.loads((tmp_path / "STATICCHECK.json").read_text())
+    assert rec["ok"] is True
+    assert rec["programs"]["grouped/span/k8-fused"]["psum_clients"] == 1
+    assert rec["programs"]["grouped/slices/k8-fused"]["psum_clients"] == 1
+    for name, p in rec["programs"].items():
+        assert p["aliased"] == p["donation_expected"], name
