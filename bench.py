@@ -22,7 +22,12 @@ BENCH_STRATEGY=masked|grouped (primary engine), BENCH_SUPERSTEP=K to fuse K
 rounds per compiled dispatch (train_superstep; phases amortize per round),
 BENCH_BOTH=0/1 to disable/force the second-strategy record in
 extra.strategies (default: on except budget-constrained fallbacks),
-BENCH_FETCH_EVERY=K to batch the D2H metric fetch.
+BENCH_FETCH_EVERY=K to batch the D2H metric fetch, BENCH_EVAL_INTERVAL=E to
+run the sBN+eval cadence every E rounds -- the primary record then uses the
+EVAL-FUSED superstep (eval inside the compiled scan, ISSUE 4) and
+extra.strategies carries `<engine>+eval-fused` vs `<engine>+eval-host`
+rows, the host row paying the PR 2 clamp (dispatch windows shortened to
+min(K, E)) plus a host `eval` phase per window.
 
 'value' is like-for-like across strategies: the average per-round seconds
 over timed rounds EXCLUDING rounds that compiled a fresh program shape
@@ -426,35 +431,92 @@ def main():
     # (train_superstep) -- each timed dispatch then covers K rounds and the
     # phase breakdown is amortized per round (the ISSUE 2 acceptance metric)
     superstep = env_int("BENCH_SUPERSTEP", 1)
+    # BENCH_EVAL_INTERVAL=E (ISSUE 4 satellite): sBN+eval cadence.  0 = off.
+    try:
+        eval_iv = max(0, int(os.environ.get("BENCH_EVAL_INTERVAL", "0") or 0))
+    except ValueError:
+        print(f"bench: ignoring malformed BENCH_EVAL_INTERVAL="
+              f"{os.environ['BENCH_EVAL_INTERVAL']!r}", file=sys.stderr)
+        eval_iv = 0
+    evaluator = fused_ev = eval_local = eval_global = eval_sbn = None
+    if eval_iv:
+        # staged through the driver's own assembly (entry.common) so the
+        # benched eval operands are laid out exactly as the driver commits
+        from heterofl_tpu.entry.common import stage_eval_operands
+        from heterofl_tpu.parallel.evaluation import Evaluator
+
+        eval_sbn, eval_local, eval_global = stage_eval_operands(
+            cfg, ds["train"], ds["test"], split["test"], lm)
+        evaluator = Evaluator(model, cfg, mesh, seed=0)
+        fused_ev = evaluator.fused(sbn_batches=eval_sbn, local_eval=eval_local,
+                                   global_eval=eval_global)
     pipe = MetricsPipeline(fetch_every)
     base_key = jax.random.key(0)
 
-    def dispatch(eng, strat, params, i, tmr, rng_):
+    def dispatch(eng, strat, params, i, tmr, rng_, eval_mode=None, k_disp=None):
         """One timed dispatch: a single round (superstep==1) or a fused
-        K-round superstep.  Returns (params, PendingMetrics)."""
-        if superstep > 1:
-            epoch0 = 1 + i * superstep
+        K-round superstep -- with BENCH_EVAL_INTERVAL, either eval-fused
+        (the mask rides the compiled scan) or host-loop (eval dispatched
+        between windows under tmr.phase('eval'), PR 2 semantics).  Returns
+        (params, PendingMetrics)."""
+        k_disp = k_disp or superstep
+        if k_disp > 1:
+            epoch0 = 1 + i * k_disp
+            mask = None
+            if eval_mode == "fused":
+                mask = tuple((epoch0 + j) % eval_iv == 0 for j in range(k_disp))
+                if not any(mask):
+                    mask = None
             if strat == "grouped":
                 us = np.stack([
                     np.asarray(round_users(jax.random.fold_in(base_key, epoch0 + j),
                                            users, n_active))
-                    for j in range(superstep)])
-                return eng.train_superstep(params, base_key, epoch0, superstep,
-                                           us, rates_vec[us], data, timer=tmr)
-            return eng.train_superstep(params, base_key, epoch0, superstep, data,
-                                       num_active=n_active, timer=tmr)
-        user_idx = rng_.permutation(users)[:n_active].astype(np.int32)
-        if strat == "grouped":
-            return eng.train_round(params, user_idx, rates_vec[user_idx],
-                                   data, 0.1, jax.random.key(i),
-                                   timer=tmr, async_metrics=True)
-        params, ms = eng.train_round(params, jax.random.key(i), 0.1, user_idx,
-                                     data, timer=tmr)
-        return params, PendingMetrics(ms)
+                    for j in range(k_disp)])
+                params, pending = eng.train_superstep(
+                    params, base_key, epoch0, k_disp, us, rates_vec[us], data,
+                    timer=tmr, eval_mask=mask,
+                    fused_eval=fused_ev if mask else None)
+            else:
+                params, pending = eng.train_superstep(
+                    params, base_key, epoch0, k_disp, data,
+                    num_active=n_active, timer=tmr, eval_mask=mask,
+                    fused_eval=fused_ev if mask else None)
+        else:
+            user_idx = rng_.permutation(users)[:n_active].astype(np.int32)
+            if strat == "grouped":
+                params, pending = eng.train_round(
+                    params, user_idx, rates_vec[user_idx], data, 0.1,
+                    jax.random.key(i), timer=tmr, async_metrics=True)
+            else:
+                params, ms = eng.train_round(params, jax.random.key(i), 0.1,
+                                             user_idx, data, timer=tmr)
+                pending = PendingMetrics(ms)
+        if eval_mode == "host":
+            # the PR 2 host-loop eval: one host eval round-trip per window
+            # CONTAINING an eval epoch (for eval_iv <= K the clamp makes
+            # that the window's last round; for eval_iv > K windows the
+            # cadence doesn't divide, the eval lands at the window end --
+            # same round-trip count per eval_iv rounds, which is what the
+            # A/B measures)
+            epoch0_w = 1 + i * k_disp
+            if any((epoch0_w + j) % eval_iv == 0 for j in range(k_disp)):
+                epoch = epoch0_w + k_disp - 1
+                # sync the train window FIRST so the `eval` phase row
+                # measures the eval round-trip itself, not the async train
+                # compute the eval's first D2H would otherwise absorb
+                with tmr.phase("compute"):
+                    jax.block_until_ready(params)
+                with tmr.phase("eval"):
+                    bn = evaluator.sbn_stats(params, *eval_sbn)
+                    evaluator.eval_users(params, bn, *eval_local, epoch=epoch)
+                    evaluator.eval_global(params, bn, *eval_global, epoch=epoch)
+        return params, pending
 
     def last_loss(fetched):
-        """Superstep fetches return a list of per-round dicts; take the
-        latest round's sums either way."""
+        """Superstep fetches return a list of per-round dicts (or the
+        train/eval dict when eval-fused); take the latest round's sums."""
+        if isinstance(fetched, dict) and "train" in fetched:
+            fetched = fetched["train"]
         return fetched[-1] if isinstance(fetched, list) else fetched
 
     def steady_stats(rsec, compile_flags):
@@ -466,7 +528,8 @@ def main():
         steady = [t for t, c in zip(rsec, compile_flags) if not c] or list(rsec)
         return sum(steady) / len(steady)
 
-    def summarize(rsec, compile_flags, compile_s, tmr, phases0, rounds_done):
+    def summarize(rsec, compile_flags, compile_s, tmr, phases0, rounds_done,
+                  k_disp=None):
         steady_avg = steady_stats(rsec, compile_flags)
         n_compile = sum(bool(c) for c in compile_flags)
         return {
@@ -482,21 +545,33 @@ def main():
             "compile_sec": round(compile_s, 1),
             "rounds_timed": rounds_done,
             # per-ROUND amortized host phases: one stage+dispatch+fetch
-            # cycle serves all K rounds of a superstep
+            # cycle serves all rounds of a dispatch window (an eval-host
+            # record additionally carries the per-window `eval` phase)
             "phases": {k: round(v, 4)
-                       for k, v in sorted(tmr.amortized(phases0, rounds_done * superstep).items())},
+                       for k, v in sorted(tmr.amortized(
+                           phases0, rounds_done * (k_disp or superstep)).items())},
         }
 
-    def measure(strat, eng, params0, tmr, hb_prefix="", on_round=None):
+    def measure(strat, eng, params0, tmr, hb_prefix="", on_round=None,
+                eval_mode=None):
         """Warmup + timed loop: THE single measurement procedure, shared by
         the primary strategy (``on_round`` handles its pipelined fetch and
-        refined per-round emits) and the alternate-strategy record (default:
-        synchronous fetch) -- one copy, so the cross-strategy like-for-like
-        claim compares identical procedures.  Returns (summary, ctx) where
-        ctx carries rsec/flags/compile_s/phases0/ms for the caller."""
+        refined per-round emits), the alternate-strategy record, and the
+        eval-fused vs eval-host rows -- one copy, so every like-for-like
+        claim compares identical procedures.  ``eval_mode`` (with
+        BENCH_EVAL_INTERVAL): 'fused' rides the eval mask inside the
+        superstep; 'host' clamps dispatch windows to min(K, E) and pays the
+        host eval round-trip per window (the PR 2 semantics).  Returns
+        (summary, ctx) where ctx carries rsec/flags/compile_s/phases0/ms."""
+        k_disp = superstep
+        if eval_mode == "fused" and superstep == 1:
+            eval_mode = "host"  # nothing to fuse into at K=1
+        if eval_mode == "host" and eval_iv:
+            k_disp = min(superstep, eval_iv)
         rng_ = np.random.default_rng(0)
         t0 = time.time()
-        p, pending = dispatch(eng, strat, params0, 0, tmr, rng_)
+        p, pending = dispatch(eng, strat, params0, 0, tmr, rng_,
+                              eval_mode=eval_mode, k_disp=k_disp)
         jax.block_until_ready(p)
         warm_ms = last_loss(pending.fetch())
         compile_s = time.time() - t0
@@ -504,15 +579,16 @@ def main():
         # shows steady-state cost, not the warmup compile in 'dispatch'
         phases0 = tmr.snapshot()
         hb(f"{hb_prefix}compile done ({compile_s:.1f}s incl. warmup dispatch)")
-        ctx = {"compile_s": compile_s, "phases0": phases0,
+        ctx = {"compile_s": compile_s, "phases0": phases0, "k_disp": k_disp,
                "rsec": [], "flags": [], "ms": warm_ms, "ms_round": 0}
         for r in range(1, timed_rounds + 1):
             size0 = eng.program_cache_size()
             t0 = time.time()
-            p, pending = dispatch(eng, strat, p, r, tmr, rng_)
+            p, pending = dispatch(eng, strat, p, r, tmr, rng_,
+                                  eval_mode=eval_mode, k_disp=k_disp)
             with tmr.phase("compute"):
                 jax.block_until_ready(p)
-            ctx["rsec"].append((time.time() - t0) / superstep)
+            ctx["rsec"].append((time.time() - t0) / k_disp)
             ctx["flags"].append(eng.program_cache_size() > size0)
             if on_round is not None:
                 on_round(r, pending, ctx)
@@ -521,8 +597,12 @@ def main():
                     ctx["ms"] = last_loss(pending.fetch())
             hb(f"{hb_prefix}round {r}/{timed_rounds} done "
                f"({ctx['rsec'][-1]:.2f}s/round)")
-        return summarize(ctx["rsec"], ctx["flags"], compile_s, tmr, phases0,
-                         timed_rounds), ctx
+        summary = summarize(ctx["rsec"], ctx["flags"], compile_s, tmr, phases0,
+                            timed_rounds, k_disp=k_disp)
+        if eval_mode is not None:
+            summary["eval_mode"] = eval_mode
+            summary["rounds_per_dispatch"] = k_disp
+        return summary, ctx
 
     def emit(ctx, rounds_done, strategies=None):
         # a degraded (non-flagship-volume / wrong-platform) run must not
@@ -536,7 +616,8 @@ def main():
         dt = steady_stats(ctx["rsec"], ctx["flags"])
         rps = 1.0 / dt
         summary = summarize(ctx["rsec"], ctx["flags"], ctx["compile_s"], timer,
-                            ctx["phases0"], rounds_done)
+                            ctx["phases0"], rounds_done,
+                            k_disp=ctx.get("k_disp"))
         del summary["value"]  # the top-level "value" IS this number
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
         print(json.dumps({
@@ -557,6 +638,7 @@ def main():
                           "misses": cache_counters["requests"] - cache_counters["hits"]},
                       **({"staticcheck": staticcheck} if staticcheck else {}),
                       **({"superstep_rounds": superstep} if superstep != 1 else {}),
+                      **({"eval_interval": eval_iv} if eval_iv else {}),
                       **({"fetch_every": fetch_every,
                           "final_loss_round": ctx["ms_round"]} if fetch_every != 1 else {}),
                       **({"strategies": strategies} if strategies else {}),
@@ -575,36 +657,65 @@ def main():
             # tag with the last ROUND the dispatch covered, not the dispatch
             # index: final_loss_round documents which round the (possibly
             # deferred) loss belongs to, and one dispatch is K rounds
-            due = pipe.push(r * superstep, pending)
+            due = pipe.push(r * ctx.get("k_disp", superstep), pending)
         if due:
             ctx["ms_round"], ctx["ms"] = due[-1][0], last_loss(due[-1][1])
         emit(ctx, r)
 
     primary_summary, ctx = measure(strategy, engine, params, timer,
-                                   on_round=on_round)
+                                   on_round=on_round,
+                                   eval_mode="fused" if eval_iv else None)
     due = pipe.flush()
     if due:  # deferred-fetch tail: re-emit with the final round's loss
         ctx["ms_round"], ctx["ms"] = due[-1][0], last_loss(due[-1][1])
         emit(ctx, timed_rounds)
+
+    def try_measure(strat, hb_prefix, eval_mode=None):
+        """An extra record must never kill the primary one."""
+        hb(f"{hb_prefix}building engine")
+        try:
+            s, _ = measure(strat, make_engine(strat),
+                           model.init(jax.random.key(0)), PhaseTimer(),
+                           hb_prefix=hb_prefix, eval_mode=eval_mode)
+            return s
+        except Exception as e:
+            print(f"bench: extra record {hb_prefix.strip()} failed: {e!r}",
+                  file=sys.stderr)
+            return {"error": repr(e)}
 
     # both-strategy record (ISSUE 2 satellite): measure the OTHER engine on
     # the same config so the grouped engine's small-width FLOP reduction
     # lands in the BENCH_*.json trajectory, not only in scripts/
     # grouped_flops.py.  Skipped on the budget-constrained fallback paths
     # (the insurance line must print); BENCH_BOTH=0 disables, =1 forces.
+    # With BENCH_EVAL_INTERVAL the strategies dict carries eval-fused vs
+    # eval-host rows per engine (ISSUE 4 satellite) -- the A/B that shows
+    # the last per-eval-window host round-trip disappearing.
     both_default = "0" if (fallback or realwidth) else "1"
-    if os.environ.get("BENCH_BOTH", both_default) == "1":
-        alt = "grouped" if strategy != "grouped" else "masked"
-        hb(f"alt strategy {alt}: building engine")
-        try:
-            alt_summary, _ = measure(alt, make_engine(alt),
-                                     model.init(jax.random.key(0)),
-                                     PhaseTimer(), hb_prefix=f"[{alt}] ")
-        except Exception as e:  # the primary record must survive an alt crash
-            print(f"bench: alt strategy {alt} failed: {e!r}", file=sys.stderr)
-            alt_summary = {"error": repr(e)}
-        emit(ctx, timed_rounds,
-             strategies={strategy: primary_summary, alt: alt_summary})
+    both = os.environ.get("BENCH_BOTH", both_default) == "1"
+    alt = "grouped" if strategy != "grouped" else "masked"
+    strategies = {}
+    if eval_iv:
+        # key each row by the mode that actually RAN (measure() degrades
+        # fused->host at superstep==1, where there is no scan to fuse into)
+        pmode = primary_summary.get("eval_mode", "fused")
+        strategies[f"{strategy}+eval-{pmode}"] = primary_summary
+        if pmode == "fused":
+            strategies[f"{strategy}+eval-host"] = try_measure(
+                strategy, f"[{strategy}/eval-host] ", eval_mode="host")
+        if both:
+            alt_fused = try_measure(alt, f"[{alt}/eval-fused] ",
+                                    eval_mode="fused")
+            amode = alt_fused.get("eval_mode", "fused")
+            strategies[f"{alt}+eval-{amode}"] = alt_fused
+            if amode == "fused":
+                strategies[f"{alt}+eval-host"] = try_measure(
+                    alt, f"[{alt}/eval-host] ", eval_mode="host")
+    elif both:
+        strategies[strategy] = primary_summary
+        strategies[alt] = try_measure(alt, f"[{alt}] ")
+    if strategies:
+        emit(ctx, timed_rounds, strategies=strategies)
 
 
 if __name__ == "__main__":

@@ -123,15 +123,21 @@ DEFAULT_CFG: Dict[str, Any] = {
     # fused multi-round superstep: compile lax.scan over K federated rounds
     # into ONE jitted/donated program (parallel round_engine/grouped
     # train_superstep) -- per-round sampling, dynamic rate re-roll, failure
-    # injection and the LR schedule all run in-jit, metrics accumulate on
-    # device and cross to the host once per superstep.  1 = one program per
-    # round (current behavior).  K>1 requires a stateless LR schedule (no
-    # ReduceLROnPlateau), eval_interval divisible by K, and
-    # metrics_fetch_every in {1, K} (the superstep IS the fetch batch);
-    # checkpoints/resume land on superstep boundaries.  Under the masked
-    # engine with replicated placement the per-round active set is sampled
-    # in-jit from the jax key stream (fed.core.round_users) -- NOT the
-    # drivers' numpy permutation stream used at superstep_rounds=1.
+    # injection, the LR schedule AND the sBN+eval cadence all run in-jit
+    # (eval rounds fire inside the scan on a static mask; eval_interval no
+    # longer clamps K), metrics -- train and eval -- accumulate on device
+    # and cross to the host once per superstep.  1 = one program per round
+    # (host-loop eval, reference parity).  K>1 requires a mesh-native
+    # strategy and metrics_fetch_every in {1} or multiples of K (whole
+    # supersteps defer); ReduceLROnPlateau works when eval_interval % K == 0
+    # (LR rides as a per-superstep scalar, stepped on the fused eval metrics
+    # at superstep boundaries) and metrics_fetch_every <= K.  Checkpoints/
+    # resume land on superstep boundaries; best-copy pivots on the LAST eval
+    # of each superstep (intermediate evals log + feed Plateau but their
+    # params are consumed inside the scan).  Under the masked engine with
+    # replicated placement the per-round active set is sampled in-jit from
+    # the jax key stream (fed.core.round_users) -- NOT the drivers' numpy
+    # permutation stream used at superstep_rounds=1.
     "superstep_rounds": 1,
     "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data

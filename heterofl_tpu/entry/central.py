@@ -218,7 +218,10 @@ class CentralExperiment:
             logger.write("train", list(named))
             bn = {}
             if self.kind == "vision":
+                # staticcheck: allow(no-host-eval-in-driver): centralized
+                # (non-federated) epoch loop -- no superstep to fuse into
                 bn = self.evaluator.sbn_stats(params, *sbn_batches)
+            # staticcheck: allow(no-host-eval-in-driver): centralized loop
             g = self.evaluator.eval_global(params, bn, *geval, epoch=epoch)
             named_g = summarize_sums({k: np.asarray(v) for k, v in g.items()},
                                      cfg["model_name"], prefix="")

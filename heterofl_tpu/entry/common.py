@@ -108,6 +108,41 @@ def _batch_array(x: np.ndarray, b: int, pad_value=0) -> Tuple[np.ndarray, np.nda
     return x.reshape((s, b) + x.shape[1:]), w.reshape(s, b)
 
 
+def stage_local_eval(xu: np.ndarray, yu: np.ndarray, mu: np.ndarray,
+                     batch_size: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-user test shards ``[U, N, ...]`` -> batched ``[U, S, B, ...]``
+    (tail padded with zero-weight samples): THE Local-eval operand layout,
+    shared by the driver, the staticcheck eval-fused audit and bench.py so
+    their committed operands cannot drift apart."""
+    u, n = xu.shape[0], xu.shape[1]
+    b = min(batch_size, n)
+    s = math.ceil(n / b)
+    pad = s * b - n
+    if pad:
+        xu = np.concatenate([xu, np.zeros((u, pad) + xu.shape[2:], xu.dtype)], 1)
+        yu = np.concatenate([yu, np.zeros((u, pad), yu.dtype)], 1)
+        mu = np.concatenate([mu, np.zeros((u, pad), np.float32)], 1)
+    return (xu.reshape(u, s, b, *xu.shape[2:]), yu.reshape(u, s, b),
+            mu.reshape(u, s, b))
+
+
+def stage_eval_operands(cfg, train_set, test_set, test_split, lm):
+    """THE vision eval-operand assembly -- ``(sbn_batches, local_eval,
+    global_eval)`` exactly as the driver commits them -- shared by
+    :meth:`FedExperiment.stage`, the staticcheck eval-fused audit and
+    bench.py, so the audited/benched operand layout cannot drift from the
+    driver's."""
+    users = cfg["num_users"]
+    sbn = _batch_array(train_set.data, cfg["batch_size"]["train"])
+    b = cfg["batch_size"]["test"]
+    xg, wg = _batch_array(test_set.data, b)
+    yg, _ = _batch_array(test_set.target, b)
+    xu, yu, mu = stack_client_shards(test_set.data, test_set.target,
+                                     test_split, list(range(users)))
+    local = stage_local_eval(xu, yu, mu, b) + (lm,)
+    return sbn, local, (xg, yg, wg)
+
+
 def _maybe_compute_norm_stats(cfg: Dict[str, Any], dataset: Dict[str, Any]) -> None:
     """Datasets without a DATASET_STATS entry get per-channel stats computed
     from the train split (cached; ref utils.py:218-228 ``make_stats``)."""
@@ -159,22 +194,15 @@ class FedExperiment:
         # per-round metric sums stay on device and are drained every
         # cfg['metrics_fetch_every'] rounds (eval boundaries flush)
         self.phase_timer = PhaseTimer()
-        self.metrics_pipe = MetricsPipeline(int(cfg.get("metrics_fetch_every", 1) or 1))
+        fetch_every = int(cfg.get("metrics_fetch_every", 1) or 1)
         eval_iv = max(1, int(cfg.get("eval_interval", 1) or 1))
-        if self.metrics_pipe.fetch_every > eval_iv:
-            import warnings
-
-            # evaluate() drains the pipeline, so batches never grow past the
-            # eval interval -- say so instead of silently under-delivering
-            warnings.warn(
-                f"metrics_fetch_every={self.metrics_pipe.fetch_every} exceeds "
-                f"eval_interval={eval_iv}: each eval boundary flushes the metric "
-                f"pipeline, so the effective fetch batch is eval_interval rounds")
+        self.eval_interval = eval_iv
         if cfg.get("strategy", "masked") not in ("masked", "sliced", "grouped"):
             raise ValueError(f"Not valid strategy: {cfg.get('strategy')!r}")
-        # fused multi-round superstep (ISSUE 2): K rounds per compiled
-        # program.  The knob interacts with every host-boundary feature, so
-        # conflicts fail LOUDLY here instead of silently changing semantics.
+        # fused multi-round superstep (ISSUE 2) with the sBN+eval phase
+        # folded into the scan (ISSUE 4): K rounds per compiled program,
+        # eval windows no longer clamp K.  Most knob combinations are now
+        # expressible in-jit; the remaining conflicts fail LOUDLY here.
         self.superstep_rounds = max(1, int(cfg.get("superstep_rounds", 1) or 1))
         if self.superstep_rounds > 1:
             K = self.superstep_rounds
@@ -183,25 +211,62 @@ class FedExperiment:
                     "superstep_rounds>1 needs a mesh-native engine "
                     "(strategy 'masked' or 'grouped'); 'sliced' is the "
                     "host-orchestrated debug twin")
+            if fetch_every != 1 and fetch_every % K:
+                raise ValueError(
+                    f"metrics_fetch_every={fetch_every} conflicts with "
+                    f"superstep_rounds={K}: a superstep fetches its metrics "
+                    f"exactly once per K rounds (use 1 for synchronous fetch "
+                    f"or a multiple of {K} to defer whole supersteps)")
             if isinstance(self.scheduler, PlateauScheduler):
-                raise ValueError(
-                    "superstep_rounds>1 evaluates the LR schedule in-jit from "
-                    "the round index; ReduceLROnPlateau feeds on eval metrics "
-                    "and cannot run inside a superstep (set superstep_rounds=1 "
-                    "or pick a stateless scheduler)")
-            if self.metrics_pipe.fetch_every not in (1, K):
-                raise ValueError(
-                    f"metrics_fetch_every={self.metrics_pipe.fetch_every} "
-                    f"conflicts with superstep_rounds={K}: a superstep fetches "
-                    f"its metrics exactly once per K rounds (set "
-                    f"metrics_fetch_every to 1 or {K})")
-            if eval_iv % K:
-                raise ValueError(
-                    f"eval_interval={eval_iv} must be a multiple of "
-                    f"superstep_rounds={K}: eval boundaries clamp the superstep "
-                    f"(K = min(superstep_rounds, rounds-to-next-eval)) and a "
-                    f"misaligned interval would silently recompile shorter "
-                    f"supersteps every cycle")
+                # ISSUE 4 relaxation: Plateau IS expressible now -- the LR is
+                # constant within a superstep (staged scalar, not the traced
+                # schedule) and steps on the fused eval metrics at superstep
+                # boundaries.  That needs every eval to land on the FINAL
+                # round of its superstep and the metrics fetched before the
+                # next superstep dispatches.
+                if eval_iv % K:
+                    raise ValueError(
+                        f"ReduceLROnPlateau with superstep_rounds={K} needs "
+                        f"eval boundaries on superstep boundaries "
+                        f"(eval_interval % superstep_rounds == 0, got "
+                        f"eval_interval={eval_iv}): a mid-superstep eval "
+                        f"would require an LR step inside the compiled scan")
+                if fetch_every > K:
+                    raise ValueError(
+                        f"ReduceLROnPlateau feeds on each superstep's eval "
+                        f"metrics before the next superstep dispatches; "
+                        f"metrics_fetch_every={fetch_every} would defer them "
+                        f"(use 1 or {K})")
+            if eval_iv % K and K % eval_iv:
+                import math
+                import warnings
+
+                # legal (the mask is data for the driver, structure for the
+                # compiler) but worth a loud note: each distinct mask pattern
+                # compiles its own K-round program (~40s at flagship scale)
+                warnings.warn(
+                    f"eval_interval={eval_iv} and superstep_rounds={K} are "
+                    f"mutually non-divisible: the eval mask cycles through "
+                    f"{math.lcm(eval_iv, K) // K} patterns, each compiling "
+                    f"its own superstep program (cached and bounded, but "
+                    f"expensive); align one to a multiple of the other to "
+                    f"avoid the extra compiles")
+            # the superstep pipeline counts PUSHES (one per superstep of K
+            # rounds), so fetch_every=m*K defers m whole supersteps
+            self.metrics_pipe = MetricsPipeline(max(1, fetch_every // K))
+        else:
+            self.metrics_pipe = MetricsPipeline(fetch_every)
+            if self.metrics_pipe.fetch_every > eval_iv:
+                import warnings
+
+                # evaluate() drains the pipeline, so batches never grow past
+                # the eval interval -- say so instead of silently
+                # under-delivering
+                warnings.warn(
+                    f"metrics_fetch_every={self.metrics_pipe.fetch_every} exceeds "
+                    f"eval_interval={eval_iv}: each eval boundary flushes the metric "
+                    f"pipeline, so the effective fetch batch is eval_interval rounds")
+        self._fused = None  # FusedEval, built on first eval-bearing superstep
         self.alt_engine = None
         if cfg.get("strategy") == "sliced":
             from ..fed.sliced import SlicedFederation
@@ -234,25 +299,12 @@ class FedExperiment:
             x, y, m = stack_client_shards(tr.data, tr.target, data_split["train"], list(range(U)))
             lm = label_split_masks(label_split, U, cfg["classes_size"])
             self.train_data = self._place((x, y, m, lm))
-            # sBN recalibration batches over the whole train set
-            xb, wb = _batch_array(tr.data, cfg["batch_size"]["train"])
-            self.sbn_batches = (xb, wb)
-            te = self.dataset["test"]
-            xg, wg = _batch_array(te.data, cfg["batch_size"]["test"])
-            yg, _ = _batch_array(te.target, cfg["batch_size"]["test"])
-            self.global_eval = (xg, yg, wg)
-            # per-user local eval shards, batched
-            xu, yu, mu = stack_client_shards(te.data, te.target, data_split["test"], list(range(U)))
-            n = xu.shape[1]
-            b = min(cfg["batch_size"]["test"], n)
-            s = math.ceil(n / b)
-            pad = s * b - n
-            if pad:
-                xu = np.concatenate([xu, np.zeros((U, pad) + xu.shape[2:], xu.dtype)], 1)
-                yu = np.concatenate([yu, np.zeros((U, pad), yu.dtype)], 1)
-                mu = np.concatenate([mu, np.zeros((U, pad), np.float32)], 1)
-            self.local_eval = (xu.reshape(U, s, b, *xu.shape[2:]), yu.reshape(U, s, b),
-                               mu.reshape(U, s, b), lm)
+            # sBN recalibration batches over the whole train set, per-user
+            # local eval shards, batched global test set -- the shared
+            # assembly (audit/bench stage the same layout)
+            self.sbn_batches, self.local_eval, self.global_eval = \
+                stage_eval_operands(cfg, tr, self.dataset["test"],
+                                    data_split["test"], lm)
         else:
             tr = self.dataset["train"]
             rows = stack_client_token_rows(tr.token, data_split["train"], list(range(U)))
@@ -334,12 +386,41 @@ class FedExperiment:
                                    self.cfg["num_users"], self.num_active))
             for r in range(k)])
 
+    def _fused_eval(self):
+        """The experiment's :class:`~..parallel.evaluation.FusedEval`: eval
+        operands committed once (shared with the host-path memos), built
+        lazily on the first eval-bearing superstep."""
+        if self._fused is None:
+            if self.kind == "vision":
+                self._fused = self.evaluator.fused(
+                    sbn_batches=self.sbn_batches, local_eval=self.local_eval,
+                    global_eval=self.global_eval)
+            else:
+                self._fused = self.evaluator.fused(global_eval=self.global_eval)
+        return self._fused
+
     def train_superstep(self, params, epoch0: int, k: int, logger: Logger):
         """Run rounds ``epoch0 .. epoch0+k-1`` as ONE compiled program
         (``superstep_rounds``): the round boundary leaves the host -- one
         stage+dispatch cycle and one metric fetch serve all k rounds, and the
-        per-round phase breakdown is the amortized cost (PhaseTimer)."""
+        per-round phase breakdown is the amortized cost (PhaseTimer).
+
+        Rounds where the eval cadence fires (``epoch % eval_interval == 0``
+        or the final round) run the fused sBN+eval phase INSIDE the program
+        (ISSUE 4): the static eval mask keys the compiled superstep, the
+        eval results come back in the same per-superstep fetch, and the last
+        per-eval-window host round-trip is gone -- ``eval_interval`` no
+        longer clamps K."""
         cfg = self.cfg
+        n_rounds = cfg["num_epochs"]["global"]
+        mask = tuple((epoch0 + r) % self.eval_interval == 0
+                     or (epoch0 + r) == n_rounds for r in range(k))
+        fused = self._fused_eval() if any(mask) else None
+        plateau = isinstance(self.scheduler, PlateauScheduler)
+        # Plateau holds the LR constant between metric steps, and steps only
+        # at superstep boundaries (validated in __init__): the superstep
+        # takes it as a staged scalar instead of the traced schedule
+        lr_const = self.scheduler(epoch0) if plateau else None
         t0 = time.time()
         phases0 = self.phase_timer.snapshot()
         if cfg.get("strategy") == "grouped":
@@ -350,7 +431,9 @@ class FedExperiment:
                 for r in range(k)])
             params, pending = self.alt_engine.train_superstep(
                 params, self.host_key, epoch0, k, users, rates,
-                self.train_data, timer=self.phase_timer)
+                self.train_data, timer=self.phase_timer,
+                eval_mask=mask if fused else None, fused_eval=fused,
+                lr=lr_const)
         else:
             sched = None
             if cfg.get("data_placement") == "sharded":
@@ -358,20 +441,63 @@ class FedExperiment:
             params, pending = self.engine.train_superstep(
                 params, self.host_key, epoch0, k, self.train_data,
                 user_schedule=sched, num_active=self.num_active,
-                timer=self.phase_timer)
+                timer=self.phase_timer, eval_mask=mask if fused else None,
+                fused_eval=fused, lr=lr_const)
+        tag = {"kind": "superstep", "epoch0": epoch0, "k": k, "dt": 0.0,
+               "phases": {},
+               "lrs": [self.scheduler(epoch0 + r) for r in range(k)]}
         with self.phase_timer.phase("fetch"):
-            ms_rounds = pending.fetch()
+            due = self.metrics_pipe.push(tag, pending)
+        # dt/phases fill in AFTER the push (the tag object rides the
+        # pipeline, so deferred entries carry their own superstep's values);
+        # at the sync default every superstep drains immediately
         dt = time.time() - t0
-        per_round = dt / k
-        phases = self.phase_timer.amortized(phases0, k)
+        tag["dt"] = dt
+        tag["phases"] = self.phase_timer.amortized(phases0, k)
         if self._first_round_done:
-            self._round_times.extend([per_round] * k)
+            self._round_times.extend([dt / k] * k)
         else:
             self._first_round_done = True  # exclude the compile superstep
-        for r, ms in enumerate(ms_rounds):
-            self._log_train_round(logger, epoch0 + r, self.scheduler(epoch0 + r),
-                                  per_round, phases, ms)
+        for tag0, out in due:
+            self._log_superstep(logger, tag0, out)
         return params
+
+    def _log_superstep(self, logger: Logger, tag: Dict[str, Any], out):
+        """Log one (possibly deferred) superstep's rounds: train metrics per
+        round, with each fused eval's Local/Global metrics logged right
+        after the round it evaluated -- the K=1 host-loop ordering."""
+        rounds = out["train"] if isinstance(out, dict) else out
+        evals = {e["epoch"]: e for e in out["eval"]} if isinstance(out, dict) else {}
+        per_round = tag["dt"] / tag["k"]
+        for r in range(tag["k"]):
+            epoch = tag["epoch0"] + r
+            self._log_train_round(logger, epoch, tag["lrs"][r], per_round,
+                                  tag["phases"], rounds[r])
+            ev = evals.get(epoch)
+            if ev is not None:
+                self._log_fused_eval(logger, epoch, ev)
+                if isinstance(self.scheduler, PlateauScheduler):
+                    # same feed as the K=1 path: min-mode plateau on the
+                    # test Global loss of rounds that evaluated
+                    self.scheduler.step_metric(
+                        logger.mean.get("test/Global-Loss", 0.0))
+
+    def _log_fused_eval(self, logger: Logger, epoch: int, ev: Dict[str, Any]):
+        """Mirror :meth:`evaluate`'s logging for one fused eval result."""
+        cfg = self.cfg
+        if self.kind == "vision" and ev["local"]:
+            local = ev["local"]
+            named_local = summarize_sums(local, cfg["model_name"])
+            logger.append(named_local, "test", n=float(np.sum(local["n"])))
+        named_global = summarize_sums({k: np.asarray(v) for k, v in ev["global"].items()},
+                                      cfg["model_name"], prefix="Global-")
+        logger.append(named_global, "test", n=ev["global"]["n"])
+        info = {"info": [f"Model: {self.tag}", f"Test Epoch: {epoch}"]}
+        logger.append(info, "test", mean=False)
+        test_names = [n.split("/", 1)[1] for n in logger.mean if n.startswith("test/")]
+        logger.write("test", test_names)
+        self.bn_state = ev["bn"]
+        return named_global
 
     def _log_train_round(self, logger: Logger, epoch: int, lr: float, dt: float,
                          phases: Dict[str, float], ms: Dict[str, np.ndarray]):
@@ -397,21 +523,33 @@ class FedExperiment:
         with self.phase_timer.phase("fetch"):
             due = self.metrics_pipe.flush()
         for tag, ms_host in due:
-            self._log_train_round(logger, tag["epoch"], tag["lr"], tag["dt"],
-                                  tag["phases"], ms_host)
+            if tag.get("kind") == "superstep":
+                self._log_superstep(logger, tag, ms_host)
+            else:
+                self._log_train_round(logger, tag["epoch"], tag["lr"], tag["dt"],
+                                      tag["phases"], ms_host)
 
     def evaluate(self, params, epoch: int, logger: Logger, label_split) -> Dict[str, float]:
+        """Host-loop sBN + Local/Global eval -- the ``superstep_rounds=1``
+        reference path (supersteps run the same phases in-program via
+        :meth:`_fused_eval`; the staticcheck lint keeps host eval dispatch
+        out of the steady-state superstep stride)."""
         self._drain_metrics(logger)  # eval boundary: fetch any deferred rounds
         cfg = self.cfg
         bn = {}
         if self.kind == "vision":
+            # staticcheck: allow(no-host-eval-in-driver): the K=1 host-loop
+            # eval path; supersteps fuse these phases in-program
             bn = self.evaluator.sbn_stats(params, *self.sbn_batches)
             xu, yu, mu, lm = self.local_eval
+            # staticcheck: allow(no-host-eval-in-driver): K=1 host-loop path
             local = self.evaluator.eval_users(params, bn, xu, yu, mu, lm, epoch=epoch)
             named_local = summarize_sums(local, cfg["model_name"])
             logger.append(named_local, "test", n=float(np.sum(local["n"])))
+            # staticcheck: allow(no-host-eval-in-driver): K=1 host-loop path
             g = self.evaluator.eval_global(params, bn, *self.global_eval, epoch=epoch)
         else:
+            # staticcheck: allow(no-host-eval-in-driver): K=1 host-loop path
             g = self.evaluator.eval_global(params, {}, *self.global_eval, epoch=epoch)
         named_global = summarize_sums({k: np.asarray(v) for k, v in g.items()},
                                       cfg["model_name"], prefix="Global-")
@@ -465,37 +603,49 @@ class FedExperiment:
                 if blob.get("scheduler_state") and hasattr(self.scheduler, "load_state_dict"):
                     self.scheduler.load_state_dict(blob["scheduler_state"])
         n_rounds = cfg["num_epochs"]["global"]
-        eval_interval = max(1, int(cfg.get("eval_interval", 1) or 1))
+        eval_interval = self.eval_interval
         epoch = last_epoch
         while epoch <= n_rounds:
             logger.safe(True)
-            # superstep length: clamp to the next eval boundary and the end
-            # of the run (K = min(superstep_rounds, rounds-to-next-eval));
-            # checkpoints therefore land on superstep boundaries.
+            # superstep length: the end of the run is the ONLY clamp left --
+            # eval windows run inside the scan (ISSUE 4), so K no longer
+            # shortens to the next eval boundary.  Checkpoints land on
+            # superstep boundaries; evals inside a superstep are logged (and
+            # feed Plateau) when its metrics are fetched.
             k_eff = 1
             if self.superstep_rounds > 1:
-                to_eval = eval_interval - ((epoch - 1) % eval_interval)
-                k_eff = min(self.superstep_rounds, to_eval, n_rounds - epoch + 1)
-                # a clamped tail still goes through the superstep path (k=1)
-                # so ONE sampling stream covers the whole run
+                k_eff = min(self.superstep_rounds, n_rounds - epoch + 1)
+                # a clamped end-of-run tail still goes through the superstep
+                # path (smaller k) so ONE sampling stream covers the run
                 params = self.train_superstep(params, epoch, k_eff, logger)
+                epoch = epoch + k_eff - 1  # last round this iteration covered
+                # pivot integrity: the checkpoint below holds END-OF-SUPERSTEP
+                # params, so only an eval on the boundary round -- fetched
+                # synchronously, i.e. logged THIS iteration -- may update the
+                # best-copy pivot; mid-superstep evals log and feed Plateau
+                # but their params were consumed inside the scan
+                pivot_fresh = (self.metrics_pipe.fetch_every == 1
+                               and (epoch % eval_interval == 0
+                                    or epoch == n_rounds))
             else:
+                pivot_fresh = True
                 lr = self.scheduler(epoch)
                 params = self.train_round(params, epoch, lr, logger)
-            epoch = epoch + k_eff - 1  # last round this iteration covered
-            evaluated = epoch % eval_interval == 0 or epoch == n_rounds
-            if evaluated:
-                self.evaluate(params, epoch, logger, label_split)
-            if isinstance(self.scheduler, PlateauScheduler) and evaluated:
-                # min-mode plateau fed the test Global loss, only on rounds
-                # that actually evaluated.  (The reference feeds
-                # logger.mean['train/Global-Accuracy'], a key its train loop
-                # never writes, i.e. a constant 0 -- an upstream bug we do
-                # not reproduce.)
-                self.scheduler.step_metric(logger.mean.get("test/Global-Loss", 0.0))
+                evaluated = epoch % eval_interval == 0 or epoch == n_rounds
+                if evaluated:
+                    self.evaluate(params, epoch, logger, label_split)
+                    if isinstance(self.scheduler, PlateauScheduler):
+                        # min-mode plateau fed the test Global loss, only on
+                        # rounds that actually evaluated.  (The reference
+                        # feeds logger.mean['train/Global-Accuracy'], a key
+                        # its train loop never writes, i.e. a constant 0 --
+                        # an upstream bug we do not reproduce.)
+                        self.scheduler.step_metric(
+                            logger.mean.get("test/Global-Loss", 0.0))
             logger.safe(False)
             cur = logger.history.get(f"test/{pivot_metric}", [None])[-1]
-            is_best = cur is not None and (cur > pivot if pivot_mode == "max" else cur < pivot)
+            is_best = pivot_fresh and cur is not None \
+                and (cur > pivot if pivot_mode == "max" else cur < pivot)
             if is_best:
                 pivot = cur  # update BEFORE saving so a resumed run keeps it
             blob_out = {

@@ -68,13 +68,17 @@ def _evaluate_central(cfg: Dict[str, Any], seed: int, load_tag: str) -> Dict[str
     ep = max(int(blob.get("epoch") or 1) - 1, 0)
     if exp.kind == "vision":
         xs, ws = _batch_pad(exp.dataset["train"].data, cfg["batch_size"]["train"])
+        # staticcheck: allow(no-host-eval-in-driver): offline one-shot eval
+        # tool, not the federated round loop
         bn = exp.evaluator.sbn_stats(params, xs, ws)
         te = exp.dataset["test"]
         xg, wg = _batch_pad(te.data, cfg["batch_size"]["test"])
         yg, _ = _batch_pad(te.target, cfg["batch_size"]["test"])
+        # staticcheck: allow(no-host-eval-in-driver): offline eval tool
         g = exp.evaluator.eval_global(params, bn, xg, yg, wg, epoch=ep)
     else:
         xs, ws = _stack_windows(bptt_windows(exp.dataset["test"].token, cfg["bptt"]), cfg["bptt"])
+        # staticcheck: allow(no-host-eval-in-driver): offline eval tool
         g = exp.evaluator.eval_global(params, {}, xs, ws, epoch=ep)
     named = summarize_sums({k: np.asarray(v) for k, v in g.items()}, cfg["model_name"], prefix="")
     result = {"cfg": {k: v for k, v in cfg.items() if k != "vocab"},

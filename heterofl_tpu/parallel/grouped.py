@@ -413,7 +413,8 @@ class GroupedRoundEngine:
             return "slices", [self._slices[r][0] for r in sorted(self._slices, reverse=True)]
         return "span", None
 
-    def _superstep_prog(self, k: int, per_dev: int, mode: str):
+    def _superstep_prog(self, k: int, per_dev: int, mode: str, eval_mask=None,
+                        fused_eval=None, lr_arg: bool = False):
         """ONE jitted+donated ``shard_map`` program for ``k`` grouped rounds:
         the five per-level programs AND the combine fused into a single XLA
         program, wrapped in a ``lax.scan`` over the rounds (ISSUE 2).
@@ -431,8 +432,19 @@ class GroupedRoundEngine:
         ``per_dev`` is the UNIFORM per-device-per-level slot count (one
         count for all levels, bucketed by the caller), so the compile space
         stays O(k-shapes x log A) -- a per-level-count key would recompile
-        combinatorially as the sampled mix varies."""
-        key_ = (k, per_dev, mode)
+        combinatorially as the sampled mix varies.
+
+        ``eval_mask`` + ``fused_eval`` (ISSUE 4): on scan steps where the
+        static mask fires, the :class:`~.evaluation.FusedEval` core runs the
+        sBN+Local/Global eval phase on the freshly-combined globals INSIDE
+        this program (outside the slices-mode ``lax.switch``, so the eval
+        collectives stay uniform across devices); the per-training-round
+        single-psum invariant is untouched and the eval phase's reductions
+        are audited separately.  ``lr_arg``: LR as a staged scalar instead
+        of the traced schedule (ReduceLROnPlateau superstep mode)."""
+        from .round_engine import eval_fused_scan, superstep_eval_groups
+
+        key_ = (k, per_dev, mode, eval_mask, lr_arg)
         if key_ in self._superstep_progs:
             return self._superstep_progs[key_]
         gm = self.global_model
@@ -441,6 +453,9 @@ class GroupedRoundEngine:
         data_axis = "data" if n_data > 1 else None
         level_rates = sorted(self.levels, reverse=True)
         lr_fn = self._lr_fn
+        groups = superstep_eval_groups(eval_mask) if eval_mask else None
+        if groups is not None and not any(ev for _, ev, _ in groups):
+            groups = None
 
         def embed(tree, rate):
             return embed_sliced_jnp(tree, gm.specs, gm.groups, rate / self.global_rate)
@@ -454,11 +469,21 @@ class GroupedRoundEngine:
             level_los = np.asarray([self._slices[r][0] for r in level_rates],
                                    np.int32)
 
-        def sbody(params, base_key, epoch0, sched, *data):
+        n_data_args = 2 if self.is_lm else 4
+
+        def sbody(params, base_key, epoch0, *rest):
+            idx = 0
+            if lr_arg:
+                lr_const = rest[0]
+                idx = 1
+            sched = rest[idx]
+            data = rest[idx + 1:idx + 1 + n_data_args]
+            eval_ops = rest[idx + 1 + n_data_args:]
+
             def step(p, xs):
                 t, srow = xs
                 key = jax.random.fold_in(base_key, t)
-                lr = lr_fn(t)
+                lr = lr_const if lr_arg else lr_fn(t)
                 if mode == "span":
                     # srow: [L, per_dev] -- this device's slots of EVERY level
                     tot_s = tot_c = None
@@ -496,16 +521,29 @@ class GroupedRoundEngine:
                 return new_p, ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
-            new_params, ms = jax.lax.scan(step, params, (epochs, sched))
-            return new_params, ms
+            xs = (epochs, sched)
+            if groups is None:
+                new_params, ms = jax.lax.scan(step, params, xs)
+                return new_params, ms
+            # eval runs on the combined globals AFTER the round(s) it
+            # follows, outside the slices-mode switch; the shared walk keeps
+            # it at the program's top level (bit-identical-to-host contract)
+            return eval_fused_scan(step, params, xs, epochs, groups,
+                                   fused_eval, eval_ops)
 
         data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
+        lr_specs = (P(),) if lr_arg else ()
+        eval_specs = tuple(fused_eval.specs) if groups else ()
         sched_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
         ms_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
+        out_specs = (P(), ms_spec)
+        if groups is not None:
+            out_specs = out_specs + (fused_eval.out_specs,)
         fn = _shard_map(
             sbody, mesh,
-            in_specs=(P(), P(), P(), sched_spec) + data_specs,
-            out_specs=(P(), ms_spec),
+            in_specs=(P(), P(), P()) + lr_specs + (sched_spec,) + data_specs
+            + eval_specs,
+            out_specs=out_specs,
         )
         prog = jax.jit(fn, donate_argnums=(0,))
         self._superstep_progs[key_] = prog
@@ -514,7 +552,8 @@ class GroupedRoundEngine:
     def train_superstep(self, global_params: Dict[str, Any], base_key,
                         epoch0: int, k: int, user_schedule: np.ndarray,
                         rate_schedule: np.ndarray, data: Tuple,
-                        timer: PhaseTimer = None):
+                        timer: PhaseTimer = None, eval_mask=None,
+                        fused_eval=None, lr=None):
         """Run ``k`` grouped rounds as ONE compiled program.
 
         ``user_schedule``: int32 ``[k, A]`` active user ids per round (the
@@ -527,8 +566,18 @@ class GroupedRoundEngine:
         ``fold_in(base_key, epoch0 + r)``; the LR schedule is evaluated
         in-jit from the round index.  Returns ``(new_params,
         PendingMetrics)`` whose ``fetch()`` yields a list of k per-round
-        metric dicts in active-client order."""
-        if self._lr_fn is None:
+        metric dicts in active-client order.
+
+        ``eval_mask`` + ``fused_eval`` (ISSUE 4): fuse the sBN+eval phase
+        into the scan on the masked rounds; the fetch then yields
+        ``{"train": [...], "eval": [...]}`` (see
+        :meth:`~.round_engine.RoundEngine.train_superstep`).  ``lr``: stage
+        a constant LR scalar (ReduceLROnPlateau superstep mode)."""
+        from .round_engine import normalize_eval_mask
+
+        eval_mask = normalize_eval_mask(eval_mask, k, fused_eval)
+        lr_arg = lr is not None
+        if not lr_arg and self._lr_fn is None:
             self._lr_fn = make_traced_lr_fn(self.cfg)
         timer = timer if timer is not None else PhaseTimer()
         with timer.phase("stage"):
@@ -577,16 +626,19 @@ class GroupedRoundEngine:
             args = self._staging.replicated("train_data", data)
             spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
             sched_dev = self._staging.put(sched, spec=spec)
+            lr_args = (self._staging.scalar(lr),) if lr_arg else ()
+            eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
             epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
             # commit the params carry (see train_round)
             global_params = self._staging.commit(global_params)
-            prog = self._superstep_prog(k, per_dev, mode)
+            prog = self._superstep_prog(k, per_dev, mode, eval_mask=eval_mask,
+                                        fused_eval=fused_eval, lr_arg=lr_arg)
         with timer.phase("dispatch"):
-            new_params, ms = prog(global_params, base_key, epoch0_dev,
-                                  sched_dev, *args)
+            out = prog(global_params, base_key, epoch0_dev, *lr_args,
+                       sched_dev, *args, *eval_args)
 
-        def _assemble(host):
-            out = []
+        def _assemble_train(host):
+            rounds = []
             for r in range(k):
                 mr = {n: np.zeros(a, np.float32) for n in host}
                 for li, (lr_, pos) in enumerate(zip(level_rates, positions[r])):
@@ -599,7 +651,19 @@ class GroupedRoundEngine:
                             lo = self._slices[lr_][0]
                             mr[n][pos] = host[n][r, lo * per_dev:
                                                  lo * per_dev + len(pos)]
-                out.append(mr)
-            return out
+                rounds.append(mr)
+            return rounds
 
-        return new_params, PendingMetrics(ms, assemble=_assemble)
+        if eval_mask is None:
+            new_params, ms = out
+            return new_params, PendingMetrics(ms, assemble=_assemble_train)
+
+        new_params, ms, ev = out
+        eval_epochs = [epoch0 + r for r, m in enumerate(eval_mask) if m]
+
+        def _assemble_eval(host):
+            ms_h, ev_h = host
+            return {"train": _assemble_train(ms_h),
+                    "eval": fused_eval.assemble(ev_h, eval_epochs)}
+
+        return new_params, PendingMetrics((ms, ev), assemble=_assemble_eval)

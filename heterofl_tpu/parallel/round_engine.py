@@ -58,6 +58,103 @@ def _bucket_pow2(n: int) -> int:
     return p
 
 
+def eval_fused_scan(step, params, xs, epochs, groups, fused_eval, eval_ops):
+    """THE eval-fused scan-group walk, shared by both engines' superstep
+    programs (parity-critical: the bit-identical-to-host-loop contract
+    lives here, so there is exactly one copy).
+
+    Walks the static ``groups`` from :func:`superstep_eval_groups`,
+    threading the params carry through per-segment ``lax.scan``s of
+    ``step`` and running ``fused_eval.core`` on the rounds where the mask
+    fired.  The eval core always runs at the PROGRAM'S top level, never
+    inside an outer scan body: XLA compiles a while-loop body differently
+    from straight-line code (measured ~1e-7 relative drift on the local
+    eval loss reduction), so a repeated group's scan emits a params
+    SNAPSHOT per segment end (ys) and the eval phases run unrolled on the
+    stacked snapshots -- one train-body trace, one eval trace per eval
+    point, n_evals x params of transient snapshot memory.  Returns
+    ``(new_params, train_ms [k, ...], eval_ms [n_evals, ...])``."""
+    tree_map = jax.tree_util.tree_map
+    p, train_ms, eval_ms, off = params, [], [], 0
+    for n, do_eval, c in groups:
+        xs_g = tree_map(
+            lambda x, o=off, cc=c, nn=n:
+                x[o:o + cc * nn].reshape((cc, nn) + x.shape[1:]), xs)
+        if c == 1:
+            p, ms = jax.lax.scan(step, p, tree_map(lambda x: x[0], xs_g))
+            if do_eval:
+                ev = fused_eval.core(p, epochs[off + n - 1], eval_ops)
+                eval_ms.append(tree_map(lambda x: x[None], ev))
+        else:
+            # c repeats of (n train rounds + eval): only eval-bearing
+            # segments group (the trailing train-only run is always a single
+            # segment), so every outer step ends on an eval point and
+            # snapshots its params
+            def seg_body(p, xs_one):
+                p, ms = jax.lax.scan(step, p, xs_one)
+                return p, (ms, p)
+
+            p, (ms, snaps) = jax.lax.scan(seg_body, p, xs_g)
+            ms = tree_map(lambda x: x.reshape((c * n,) + x.shape[2:]), ms)
+            for j in range(c):
+                ev = fused_eval.core(
+                    tree_map(lambda x, jj=j: x[jj], snaps),
+                    epochs[off + (j + 1) * n - 1], eval_ops)
+                eval_ms.append(tree_map(lambda x: x[None], ev))
+        train_ms.append(ms)
+        off += c * n
+    ms = train_ms[0] if len(train_ms) == 1 else tree_map(
+        lambda *xs_: jnp.concatenate(xs_, 0), *train_ms)
+    ev = eval_ms[0] if len(eval_ms) == 1 else tree_map(
+        lambda *xs_: jnp.concatenate(xs_, 0), *eval_ms)
+    return p, ms, ev
+
+
+def normalize_eval_mask(eval_mask, k: int, fused_eval):
+    """Shared eval-mask validation for both engines' ``train_superstep``:
+    returns the static bool tuple, or None when no round evaluates."""
+    if eval_mask is None:
+        return None
+    eval_mask = tuple(bool(m) for m in eval_mask)
+    if len(eval_mask) != k:
+        raise ValueError(f"eval_mask must have k={k} entries, got "
+                         f"{len(eval_mask)}")
+    if not any(eval_mask):
+        return None
+    if fused_eval is None:
+        raise ValueError("eval_mask needs a FusedEval (Evaluator.fused) "
+                         "carrying the staged eval operands")
+    return eval_mask
+
+
+def superstep_eval_groups(mask):
+    """Compress a static per-round eval mask into ``[(n, do_eval, repeat)]``
+    scan groups: ``n`` training rounds followed (``do_eval``) by one fused
+    eval phase, the segment repeated ``repeat`` times as an outer scan.
+
+    The mask is STATIC (it keys the compiled superstep program), so the
+    program unrolls O(groups) scan segments instead of K round bodies; any
+    uniform cadence -- ``eval_interval`` dividing K, equal to K, or a
+    multiple of K -- compresses to at most one eval group plus one trailing
+    train-only group, and the steady-state mask repeats superstep to
+    superstep (no recompiles).  ``sum(n * repeat) == len(mask)``."""
+    segs, run = [], 0
+    for m in mask:
+        run += 1
+        if m:
+            segs.append((run, True))
+            run = 0
+    if run:
+        segs.append((run, False))
+    groups = []
+    for seg in segs:
+        if groups and groups[-1][0] == seg:
+            groups[-1][1] += 1
+        else:
+            groups.append([seg, 1])
+    return [(n, ev, c) for (n, ev), c in groups]
+
+
 def shard_client_data(mesh: Mesh, data: Tuple[Any, ...]) -> Tuple[jnp.ndarray, ...]:
     """Place per-user data stacks with the user axis sharded over ``clients``.
 
@@ -409,7 +506,8 @@ class RoundEngine:
         return jax.jit(fn, donate_argnums=(0,))
 
     def _build_superstep(self, k: int, per_dev: int, in_jit: bool,
-                         num_active: int = 0):
+                         num_active: int = 0, eval_mask=None, fused_eval=None,
+                         lr_arg: bool = False):
         """One jitted+donated program for ``k`` federated rounds: the round
         boundary leaves the host (ISSUE 2 tentpole).
 
@@ -423,19 +521,40 @@ class RoundEngine:
         host-packed ``[k, slots]`` schedule as scan xs (sharded placement:
         slot->owner packing is placement bookkeeping).  Per-round per-slot
         metric sums come back stacked ``[k, slots]`` -- one fetch per
-        superstep."""
+        superstep.
+
+        ``eval_mask`` (ISSUE 4 tentpole): a static k-tuple of bools; on
+        rounds where it fires, the :class:`~.evaluation.FusedEval` core --
+        sBN recalibration + Local/Global eval -- runs INSIDE this program on
+        the pre-staged eval operands (appended to the argument list with
+        ``fused_eval.specs``), and the eval results come back stacked over
+        the superstep's eval points.  The mask compresses to scan groups
+        (:func:`superstep_eval_groups`), so ``eval_interval=1`` is one
+        (round + eval) scan of length k, not k unrolled blocks.
+        ``lr_arg=True`` takes the LR as a staged scalar argument instead of
+        the traced schedule (ReduceLROnPlateau: LR is constant within a
+        superstep, stepped on eval metrics at superstep boundaries)."""
         mesh = self.mesh
         n_dev = mesh.shape["clients"]
         slots_total = per_dev * n_dev
         num_users = self.cfg["num_users"]
         lr_fn = self._lr_fn
+        data_specs = self._data_specs()
+        n_data_args = len(data_specs)
+        groups = superstep_eval_groups(eval_mask) if eval_mask else None
+        if groups is not None and not any(ev for _, ev, _ in groups):
+            groups = None  # an all-False mask is the plain train superstep
 
         def sbody(params, base_key, epoch0, *rest):
-            if in_jit:
-                data = rest
-            else:
-                sched_ul, sched_ug = rest[0], rest[1]
-                data = rest[2:]
+            idx = 0
+            if lr_arg:
+                lr_const = rest[0]
+                idx = 1
+            if not in_jit:
+                sched_ul, sched_ug = rest[idx], rest[idx + 1]
+                idx += 2
+            data = rest[idx:idx + n_data_args]
+            eval_ops = rest[idx + n_data_args:]
 
             def step(p, xs):
                 if in_jit:
@@ -450,26 +569,37 @@ class RoundEngine:
                 else:
                     t, ul, ug = xs
                     key = jax.random.fold_in(base_key, t)
-                new_p, ms = self._round_core(p, key, lr_fn(t), ul, ug, data)
+                lr = lr_const if lr_arg else lr_fn(t)
+                new_p, ms = self._round_core(p, key, lr, ul, ug, data)
                 return new_p, ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
             xs = (epochs,) if in_jit else (epochs, sched_ul, sched_ug)
-            new_params, ms = jax.lax.scan(step, params, xs)
-            return new_params, ms
+            if groups is None:
+                new_params, ms = jax.lax.scan(step, params, xs)
+                return new_params, ms
+            return eval_fused_scan(step, params, xs, epochs, groups,
+                                   fused_eval, eval_ops)
 
+        lr_specs = (P(),) if lr_arg else ()
         sched_specs = () if in_jit else (P(None, "clients"), P(None, "clients"))
+        eval_specs = tuple(fused_eval.specs) if groups else ()
+        out_specs = (P(), P(None, "clients"))
+        if groups is not None:
+            out_specs = out_specs + (fused_eval.out_specs,)
         fn = _shard_map(
             sbody, mesh,
-            in_specs=(P(), P(), P()) + sched_specs + self._data_specs(),
-            out_specs=(P(), P(None, "clients")),
+            in_specs=(P(), P(), P()) + lr_specs + sched_specs + data_specs
+            + eval_specs,
+            out_specs=out_specs,
         )
         return jax.jit(fn, donate_argnums=(0,))
 
     def train_superstep(self, params, base_key, epoch0: int, k: int,
                         data: Tuple[jnp.ndarray, ...], user_schedule=None,
                         num_active: Optional[int] = None,
-                        timer: PhaseTimer = None):
+                        timer: PhaseTimer = None, eval_mask=None,
+                        fused_eval=None, lr: Optional[float] = None):
         """Run ``k`` rounds as ONE compiled program (``superstep_rounds``).
 
         Per-round keys are ``fold_in(base_key, epoch0 + r)`` -- the driver's
@@ -481,8 +611,17 @@ class RoundEngine:
         required, packed here into owner-aligned slot arrays (scan xs).
         Returns ``(new_params, PendingMetrics)`` whose ``fetch()`` yields a
         LIST of k per-round metric dicts -- metrics accumulate on device and
-        cross to the host once per superstep."""
-        if self._lr_fn is None:
+        cross to the host once per superstep.
+
+        ``eval_mask`` + ``fused_eval`` (ISSUE 4): run the fused sBN+eval
+        phase in-program on the rounds where the static mask fires; the
+        fetch then yields ``{"train": [k dicts], "eval": [per-eval dicts]}``
+        with each eval dict carrying ``epoch``/``bn``/``local``/``global``.
+        ``lr``: stage a constant LR scalar instead of the traced schedule
+        (the ReduceLROnPlateau superstep mode)."""
+        eval_mask = normalize_eval_mask(eval_mask, k, fused_eval)
+        lr_arg = lr is not None
+        if not lr_arg and self._lr_fn is None:
             self._lr_fn = make_traced_lr_fn(self.cfg)
         timer = timer if timer is not None else PhaseTimer()
         with timer.phase("stage"):
@@ -544,23 +683,43 @@ class RoundEngine:
                 args = self._staging.replicated("train_data", data)
             if self.fix_rates is not None:
                 args = args + self._staging.replicated("fix_rates", (self.fix_rates,))
+            lr_args = (self._staging.scalar(lr),) if lr_arg else ()
+            eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
             epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
             # commit the params carry: an uncommitted init tree would
             # specialise this program once and recompile on round 2 when the
             # outputs come back mesh-committed (staticcheck recompile audit)
             params = self._staging.commit(params)
-            pkey = (k, per_dev, in_jit, a)
+            pkey = (k, per_dev, in_jit, a, eval_mask, lr_arg)
             prog = self._superstep_progs.get(pkey)
             if prog is None:
-                prog = self._build_superstep(k, per_dev, in_jit, num_active=a)
+                prog = self._build_superstep(k, per_dev, in_jit, num_active=a,
+                                             eval_mask=eval_mask,
+                                             fused_eval=fused_eval,
+                                             lr_arg=lr_arg)
                 self._superstep_progs[pkey] = prog
         with timer.phase("dispatch"):
-            new_params, ms = prog(params, base_key, epoch0_dev, *sched_args, *args)
+            out = prog(params, base_key, epoch0_dev, *lr_args, *sched_args,
+                       *args, *eval_args)
 
-        def _assemble(host):
-            return [{name: v[r] for name, v in host.items()} for r in range(k)]
+        if eval_mask is None:
+            new_params, ms = out
 
-        return new_params, PendingMetrics(ms, assemble=_assemble)
+            def _assemble(host):
+                return [{name: v[r] for name, v in host.items()} for r in range(k)]
+
+            return new_params, PendingMetrics(ms, assemble=_assemble)
+
+        new_params, ms, ev = out
+        eval_epochs = [epoch0 + r for r, m in enumerate(eval_mask) if m]
+
+        def _assemble_eval(host):
+            ms_h, ev_h = host
+            return {"train": [{name: v[r] for name, v in ms_h.items()}
+                              for r in range(k)],
+                    "eval": fused_eval.assemble(ev_h, eval_epochs)}
+
+        return new_params, PendingMetrics((ms, ev), assemble=_assemble_eval)
 
     def program_cache_size(self) -> int:
         """Total compiled specializations across this engine's train

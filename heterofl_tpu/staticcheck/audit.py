@@ -41,8 +41,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .jaxpr_walk import (aliased_outputs, count_collectives, count_psum_over,
-                         donation_marks, find_callbacks, find_f64)
+from .jaxpr_walk import (aliased_outputs, count_collectives, count_psum_joint,
+                         count_psum_over, donation_marks, find_callbacks,
+                         find_f64)
 from .report import AuditReport, Finding, ProgramReport
 
 #: FLOP-share tolerance (max relative error of measured vs analytic level
@@ -52,8 +53,14 @@ from .report import AuditReport, Finding, ProgramReport
 FLAGSHIP_FLOP_TOL = 0.02
 SMALL_FLOP_TOL = 0.45
 
-#: the PR 2 invariant: one global psum per (fused) round program
+#: the PR 2 invariant: one global psum per (fused) TRAINING round program
 PSUM_BUDGET = 1
+
+#: the ISSUE 4 eval-phase budget: the fused sBN moment reduction + the
+#: Global metric reduction, each ONE joint (clients, data) psum bind per
+#: eval point's trace (the per-user Local sums stay sharded -- no
+#: collective)
+EVAL_PSUM_BUDGET = 2
 
 
 def default_audit_cfg(flagship: bool = False) -> Dict[str, Any]:
@@ -107,9 +114,33 @@ def build_setup(flagship: bool = False, seed: int = 0) -> Dict[str, Any]:
             f"XLA_FLAGS=--xla_force_host_platform_device_count=8 before jax "
             f"initialises (the CLI and tests/conftest.py both do)")
     mesh = make_mesh(n_dev, 1)
+
+    # eval operands for the eval-fused superstep variants (ISSUE 4), staged
+    # through the DRIVER'S OWN assembly so the audited operand layout is
+    # exactly the one the driver commits
+    from ..entry.common import stage_eval_operands
+
+    sbn, local, glob = stage_eval_operands(cfg, ds["train"], ds["test"],
+                                           split["test"], lm)
+    eval_data = {"sbn": sbn, "local": local, "global": glob}
     return {"cfg": cfg, "data": data, "model": model, "params": params,
             "mesh": mesh, "flagship": flagship, "key": jax.random.key(seed),
-            "lr": np.float32(0.05), "users": users}
+            "lr": np.float32(0.05), "users": users, "eval_data": eval_data}
+
+
+def fused_eval_for(setup):
+    """One :class:`~..parallel.evaluation.FusedEval` per setup (memoised):
+    the eval-fused audit targets and the recompile check share its committed
+    operands, exactly like the driver does."""
+    if "fused_eval" not in setup:
+        from ..parallel.evaluation import Evaluator
+
+        ev = Evaluator(setup["model"], setup["cfg"], setup["mesh"], seed=0)
+        ed = setup["eval_data"]
+        setup["fused_eval"] = ev.fused(sbn_batches=ed["sbn"],
+                                       local_eval=ed["local"],
+                                       global_eval=ed["global"])
+    return setup["fused_eval"]
 
 
 def _sds(shape: Tuple[int, ...], dtype=np.int32):
@@ -159,6 +190,26 @@ def _masked_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
         eng._build_superstep(k, _ceil_div(a, n_dev), True, num_active=a),
         (params, key, np.int32(1)) + data,
         {"donated": n_leaves, "psum": PSUM_BUDGET}))
+    # eval-fused variants (ISSUE 4): the ACCEPTANCE cadence eval_interval=1
+    # (every round evaluates; the eval core is traced once per eval point,
+    # so the joint-psum budget scales with k) and the boundary cadence
+    # eval_interval=K (one eval point)
+    fe = fused_eval_for(setup)
+    targets.append((
+        "masked/replicated/k8-eval1",
+        eng._build_superstep(k, _ceil_div(a, n_dev), True, num_active=a,
+                             eval_mask=(True,) * k, fused_eval=fe),
+        (params, key, np.int32(1)) + data + tuple(fe.ops),
+        {"donated": n_leaves, "psum": PSUM_BUDGET,
+         "psum_eval": EVAL_PSUM_BUDGET * k}))
+    targets.append((
+        "masked/replicated/k8-eval8",
+        eng._build_superstep(k, _ceil_div(a, n_dev), True, num_active=a,
+                             eval_mask=(False,) * (k - 1) + (True,),
+                             fused_eval=fe),
+        (params, key, np.int32(1)) + data + tuple(fe.ops),
+        {"donated": n_leaves, "psum": PSUM_BUDGET,
+         "psum_eval": EVAL_PSUM_BUDGET}))
 
     # sharded: per-user stacks device-sharded over the clients axis
     eng_sh = RoundEngine(model, dict(cfg, data_placement="sharded"), mesh)
@@ -175,6 +226,14 @@ def _masked_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
         (params, key, np.int32(1), _sds((k, slots_sh)), _sds((k, slots_sh)))
         + data_sh,
         {"donated": n_leaves, "psum": PSUM_BUDGET}))
+    targets.append((
+        "masked/sharded/k8-eval1",
+        eng_sh._build_superstep(k, per, False, eval_mask=(True,) * k,
+                                fused_eval=fe),
+        (params, key, np.int32(1), _sds((k, slots_sh)), _sds((k, slots_sh)))
+        + data_sh + tuple(fe.ops),
+        {"donated": n_leaves, "psum": PSUM_BUDGET,
+         "psum_eval": EVAL_PSUM_BUDGET * k}))
     return targets
 
 
@@ -221,6 +280,15 @@ def _grouped_targets(setup) -> Tuple[List, Dict[str, float], Any]:
         (params, key, np.int32(1),
          _sds((k, len(level_rates), per_dev * n_dev))) + data,
         {"donated": n_leaves, "psum": PSUM_BUDGET}))
+    fe = fused_eval_for(setup)
+    targets.append((
+        "grouped/span/k8-eval1-fused",
+        grp._superstep_prog(k, per_dev, "span", eval_mask=(True,) * k,
+                            fused_eval=fe),
+        (params, key, np.int32(1),
+         _sds((k, len(level_rates), per_dev * n_dev))) + data + tuple(fe.ops),
+        {"donated": n_leaves, "psum": PSUM_BUDGET,
+         "psum_eval": EVAL_PSUM_BUDGET * k}))
 
     grp_sl = GroupedRoundEngine(dict(cfg, level_placement="slices"), mesh)
     grp_sl._lr_fn = make_traced_lr_fn(cfg)
@@ -245,6 +313,14 @@ def _grouped_targets(setup) -> Tuple[List, Dict[str, float], Any]:
                 grp_sl._superstep_prog(k, per_dev_sl, "slices"),
                 (params, key, np.int32(1), _sds((k, per_dev_sl * n_dev))) + data,
                 {"donated": n_leaves, "psum": PSUM_BUDGET}))
+            targets.append((
+                "grouped/slices/k8-eval1-fused",
+                grp_sl._superstep_prog(k, per_dev_sl, "slices",
+                                       eval_mask=(True,) * k, fused_eval=fe),
+                (params, key, np.int32(1), _sds((k, per_dev_sl * n_dev)))
+                + data + tuple(fe.ops),
+                {"donated": n_leaves, "psum": PSUM_BUDGET,
+                 "psum_eval": EVAL_PSUM_BUDGET * k}))
     return targets, level_prog_names, grp_sl
 
 
@@ -269,7 +345,11 @@ def audit_program(name: str, prog, args: Tuple, expect: Dict[str, Any],
         rep.fail("no-f64", f"{what} (bound at {prov})")
 
     counts, axes = count_collectives(jaxpr)
-    rep.psum_clients = count_psum_over(jaxpr, "clients")
+    # the eval phase's reductions bind (clients, data) JOINTLY; every
+    # training psum binds a single axis -- count them as separate budgets
+    # (ISSUE 4: "one global psum per fused round" means per TRAINING round)
+    rep.psum_eval = count_psum_joint(jaxpr, ("clients", "data"))
+    rep.psum_clients = count_psum_over(jaxpr, "clients") - rep.psum_eval
     rep.all_gather = counts.get("all_gather", 0)
     rep.collective_axes = sorted(axes)
     mesh_axes = set(mesh.axis_names)
@@ -282,6 +362,11 @@ def audit_program(name: str, prog, args: Tuple, expect: Dict[str, Any],
         rep.fail("psum-budget",
                  f"{rep.psum_clients} global psum bind(s) over the clients "
                  f"axis, budget is exactly {expect['psum']}")
+    if rep.psum_eval != expect.get("psum_eval", 0):
+        rep.fail("eval-psum-budget",
+                 f"{rep.psum_eval} joint (clients, data) psum bind(s), "
+                 f"budget is exactly {expect.get('psum_eval', 0)} (sBN + "
+                 f"Global reductions per traced eval point)")
     if rep.all_gather:
         rep.fail("collective-budget",
                  f"{rep.all_gather} all_gather bind(s); the round programs "
@@ -374,6 +459,23 @@ def recompile_hazard_check(setup) -> Dict[str, Any]:
     pend.fetch()
     out["masked_superstep"] = {"after_warm": size1,
                                "after_repeat": eng.program_cache_size()}
+
+    # eval-fused superstep (ISSUE 4): a fresh-but-identical eval mask (a NEW
+    # tuple of the same booleans) must hit the cached program -- the mask is
+    # part of the program key, so a tuple-identity (rather than equality)
+    # key would recompile the flagship program every superstep
+    fe = fused_eval_for(setup)
+    p, pend = eng.train_superstep(p, jax.random.key(3), 5, 2, data,
+                                  num_active=4, eval_mask=(True, True),
+                                  fused_eval=fe)
+    pend.fetch()
+    size1 = eng.program_cache_size()
+    p, pend = eng.train_superstep(p, jax.random.key(3), 7, 2, data,
+                                  num_active=4,
+                                  eval_mask=tuple([True] * 2), fused_eval=fe)
+    pend.fetch()
+    out["masked_superstep_eval"] = {"after_warm": size1,
+                                    "after_repeat": eng.program_cache_size()}
 
     # sharded placement superstep: the host-packed slot schedule's ownership
     # density keys the K-round program -- fresh-but-identical schedules must

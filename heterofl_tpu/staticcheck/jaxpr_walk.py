@@ -128,6 +128,22 @@ def count_psum_over(jaxpr, axis: str = "clients") -> int:
     return n
 
 
+def count_psum_joint(jaxpr, axes: Tuple[str, ...] = ("clients", "data")) -> int:
+    """psum binds whose axis set includes ALL of ``axes`` -- the eval
+    phase's whole-mesh reductions (sBN moments, Global metric sums) reduce
+    over ``(clients, data)`` jointly, while every training-round psum binds
+    a single axis, so this cleanly separates the eval-fused superstep's
+    collective budget from the one-global-psum-per-training-round
+    invariant."""
+    n = 0
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name == "psum":
+            seen = collective_axes(eqn)
+            if all(a in seen for a in axes):
+                n += 1
+    return n
+
+
 # ---------------------------------------------------------------------------
 # donation / aliasing, from the lowered & compiled IR text
 # ---------------------------------------------------------------------------

@@ -32,8 +32,12 @@ class ProgramReport:
     name: str
     ok: bool = True
     findings: List[Finding] = field(default_factory=list)
-    #: psum binds over the ``clients`` axis (the global-collective budget)
+    #: psum binds over the ``clients`` axis alone (the per-training-round
+    #: global-collective budget; eval-phase joint reductions are separate)
     psum_clients: int = 0
+    #: psum binds over ``(clients, data)`` jointly -- the eval-fused
+    #: superstep's sBN + Global reductions, audited as their own budget
+    psum_eval: int = 0
     all_gather: int = 0
     #: collective axis names seen in the program
     collective_axes: List[str] = field(default_factory=list)

@@ -60,6 +60,7 @@ class Rule:
 
 _PARALLEL = ("heterofl_tpu/parallel/",)
 _TRACED = ("heterofl_tpu/parallel/", "heterofl_tpu/fed/")
+_DRIVER = ("heterofl_tpu/entry/",)
 
 DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule("no-asarray",
@@ -105,6 +106,14 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
          _PARALLEL,
          calls=("jax.jit",),
          require_kwargs=("donate_argnums", "donate_argnames")),
+    Rule("no-host-eval-in-driver",
+         "host-side eval dispatch in the driver loop: with "
+         "superstep_rounds>1 the sBN+eval phases run INSIDE the fused "
+         "superstep program (Evaluator.fused); host "
+         "sbn_stats/eval_users/eval_global calls belong only on the K=1 "
+         "host-loop path or offline tools (pragma with the reason)",
+         _DRIVER,
+         methods=("sbn_stats", "eval_users", "eval_global")),
 )
 
 

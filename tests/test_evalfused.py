@@ -1,0 +1,409 @@
+"""Eval-fused superstep (ISSUE 4): sBN recalibration + Local/Global eval
+folded into the scanned multi-round program, for both engines.
+
+The contract under test: a superstep whose static eval mask fires on round r
+produces eval metrics BIT-IDENTICAL to the host-loop path (train to round r
+with the plain superstep, then dispatch the Evaluator's standalone sBN /
+eval_users / eval_global programs) -- same bodies, same committed operands,
+same ``fold_in(key, epoch)`` streams, and the eval phase fenced from the
+surrounding program with ``optimization_barrier`` so XLA cannot context-fuse
+its reductions differently.  Plus: zero implicit H2D per eval window in
+steady state (transfer guard), a flat program cache (one compiled dispatch
+per superstep at eval_interval=1), and the driver-level relaxations.
+"""
+
+import json
+import math
+
+import jax
+import numpy as np
+import pytest
+
+from heterofl_tpu.fed.core import round_users
+from heterofl_tpu.models import make_model
+from heterofl_tpu.parallel import (GroupedRoundEngine, RoundEngine, make_mesh,
+                                   shard_client_data)
+from heterofl_tpu.parallel.evaluation import Evaluator
+from heterofl_tpu.parallel.round_engine import superstep_eval_groups
+
+from test_round import _vision_setup
+
+HOST_KEY = jax.random.key(0)
+U = 8
+
+
+def _batch(x, b):
+    n = x.shape[0]
+    s = math.ceil(n / b)
+    pad = s * b - n
+    w = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    if pad:
+        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+    return x.reshape((s, b) + x.shape[1:]), w.reshape(s, b)
+
+
+@pytest.fixture(scope="module")
+def eval_setup():
+    """cfg + train stacks + the three eval operand groups (sbn batches,
+    per-user local shards, batched global test set), mirroring the driver's
+    ``stage()``."""
+    cfg, ds, data = _vision_setup()
+    te = ds["test"]
+    sbn_b = _batch(ds["train"].data, 20)
+    xu = te.data[:96].reshape(U, 1, 12, 28, 28, 1)
+    yu = te.target[:96].reshape(U, 1, 12)
+    wu = np.ones((U, 1, 12), np.float32)
+    lmu = np.ones((U, 10), np.float32)
+    xg, wg = _batch(te.data, 20)
+    yg, _ = _batch(te.target, 20)
+    return {"cfg": cfg, "data": data, "sbn": sbn_b,
+            "local": (xu, yu, wu, lmu), "global": (xg, yg, wg)}
+
+
+def _host_reference(model, cfg, mesh, data, chunks, es, scheds=None):
+    """Train with plain supersteps in ``chunks`` of (epoch0, k) and run the
+    host-loop eval after each chunk -- the bit-exact baseline."""
+    ev = Evaluator(model, cfg, mesh, seed=0)
+    eng = RoundEngine(model, cfg, mesh)
+    p = model.init(jax.random.key(0))
+    refs = []
+    for epoch0, k in chunks:
+        sched = scheds(epoch0, k) if scheds is not None else None
+        p, pend = eng.train_superstep(p, HOST_KEY, epoch0, k, data,
+                                      num_active=4, user_schedule=sched)
+        pend.fetch()
+        ep = epoch0 + k - 1
+        bn = ev.sbn_stats(p, *es["sbn"])
+        local = ev.eval_users(p, bn, *es["local"], epoch=ep)
+        g = ev.eval_global(p, bn, *es["global"], epoch=ep)
+        refs.append((ep, bn, local, g))
+    return p, refs
+
+
+def _fused(model, cfg, mesh, es):
+    ev = Evaluator(model, cfg, mesh, seed=0)
+    return ev.fused(sbn_batches=es["sbn"], local_eval=es["local"],
+                    global_eval=es["global"])
+
+
+def _assert_evals_bitwise(refs, fused_evals):
+    assert [e["epoch"] for e in fused_evals] == [ep for ep, *_ in refs]
+    for (ep, bn, local, g), fe in zip(refs, fused_evals):
+        for site in bn:
+            np.testing.assert_array_equal(np.asarray(bn[site][0]),
+                                          fe["bn"][site][0], err_msg=site)
+            np.testing.assert_array_equal(np.asarray(bn[site][1]),
+                                          fe["bn"][site][1], err_msg=site)
+        for nm in local:
+            np.testing.assert_array_equal(local[nm], fe["local"][nm],
+                                          err_msg=f"epoch {ep} local {nm}")
+        for nm in g:
+            assert g[nm] == fe["global"][nm], (ep, nm, g[nm], fe["global"][nm])
+
+
+# ---------------------------------------------------------------------------
+# the mask -> scan-group compression
+# ---------------------------------------------------------------------------
+
+def test_superstep_eval_groups():
+    # eval_interval=1: one repeated (round + eval) group
+    assert superstep_eval_groups((True,) * 8) == [(1, True, 8)]
+    # eval_interval divides K: one repeated group of e rounds + eval
+    assert superstep_eval_groups((False, True) * 4) == [(2, True, 4)]
+    # eval on the final round only (eval_interval == K or a multiple)
+    assert superstep_eval_groups((False,) * 7 + (True,)) == [(8, True, 1)]
+    # no eval in this window (eval_interval > K): one train-only group
+    assert superstep_eval_groups((False,) * 8) == [(8, False, 1)]
+    # trailing train-only rounds stay a separate group
+    assert superstep_eval_groups((True, False)) == [(1, True, 1), (1, False, 1)]
+    # irregular lead (misaligned epoch0): distinct groups, still covers k
+    groups = superstep_eval_groups((False, False, True, False, True))
+    assert groups == [(3, True, 1), (2, True, 1)]
+    assert sum(n * c for n, _, c in groups) == 5
+
+
+# ---------------------------------------------------------------------------
+# bit-identical equivalence vs the host-loop eval path
+# ---------------------------------------------------------------------------
+
+def test_evalfused_masked_replicated_bit_identical(eval_setup):
+    """Masked engine, replicated placement, evals mid-superstep (repeated
+    scan group): params, train metrics and every eval result are bitwise
+    equal to chunked supersteps + the standalone eval programs."""
+    es = eval_setup
+    cfg, data = es["cfg"], es["data"]
+    model = make_model(cfg)
+    mesh = make_mesh(8, 1)
+    p1, refs = _host_reference(model, cfg, mesh, data, [(1, 2), (3, 2)], es)
+
+    eng = RoundEngine(model, cfg, mesh)
+    p2 = model.init(jax.random.key(0))
+    p2, pend = eng.train_superstep(p2, HOST_KEY, 1, 4, data, num_active=4,
+                                   eval_mask=(False, True, False, True),
+                                   fused_eval=_fused(model, cfg, mesh, es))
+    out = pend.fetch()
+    for name in p1:
+        np.testing.assert_array_equal(np.asarray(p1[name]), np.asarray(p2[name]),
+                                      err_msg=name)
+    assert len(out["train"]) == 4
+    _assert_evals_bitwise(refs, out["eval"])
+
+
+def test_evalfused_eval_interval_one(eval_setup):
+    """The ISSUE 4 acceptance cadence: eval EVERY round, still one compiled
+    dispatch per superstep, every eval bitwise vs the host loop."""
+    es = eval_setup
+    cfg, data = es["cfg"], es["data"]
+    model = make_model(cfg)
+    mesh = make_mesh(8, 1)
+    p1, refs = _host_reference(model, cfg, mesh, data,
+                               [(1, 1), (2, 1), (3, 1)], es)
+
+    eng = RoundEngine(model, cfg, mesh)
+    p2 = model.init(jax.random.key(0))
+    p2, pend = eng.train_superstep(p2, HOST_KEY, 1, 3, data, num_active=4,
+                                   eval_mask=(True, True, True),
+                                   fused_eval=_fused(model, cfg, mesh, es))
+    out = pend.fetch()
+    for name in p1:
+        np.testing.assert_array_equal(np.asarray(p1[name]), np.asarray(p2[name]),
+                                      err_msg=name)
+    _assert_evals_bitwise(refs, out["eval"])
+
+
+@pytest.mark.slow
+def test_evalfused_masked_sharded_bit_identical(eval_setup):
+    """Sharded placement: the host-packed slot schedule rides the scan, the
+    eval operands stay mesh-committed, results bitwise."""
+    es = eval_setup
+    cfg = dict(es["cfg"], data_placement="sharded")
+    model = make_model(cfg)
+    mesh = make_mesh(8, 1)
+    data_s = shard_client_data(mesh, tuple(np.asarray(d) for d in es["data"]))
+
+    def scheds(epoch0, k):
+        return np.stack([
+            np.asarray(round_users(jax.random.fold_in(HOST_KEY, epoch0 + r), U, 4))
+            for r in range(k)])
+
+    p1, refs = _host_reference(model, cfg, mesh, data_s, [(1, 2), (3, 2)], es,
+                               scheds=scheds)
+    eng = RoundEngine(model, cfg, mesh)
+    p2 = model.init(jax.random.key(0))
+    p2, pend = eng.train_superstep(p2, HOST_KEY, 1, 4, data_s,
+                                   user_schedule=scheds(1, 4),
+                                   eval_mask=(False, True, False, True),
+                                   fused_eval=_fused(model, cfg, mesh, es))
+    out = pend.fetch()
+    for name in p1:
+        np.testing.assert_array_equal(np.asarray(p1[name]), np.asarray(p2[name]),
+                                      err_msg=name)
+    _assert_evals_bitwise(refs, out["eval"])
+
+
+@pytest.mark.parametrize("placement", ["span", "slices"])
+def test_evalfused_grouped_bit_identical(eval_setup, placement):
+    """Grouped engine, both level placements: the fused eval runs on the
+    combined globals outside the slices-mode switch; results bitwise vs the
+    plain grouped superstep + host evaluator."""
+    es = eval_setup
+    cfg = dict(es["cfg"], level_placement=placement)
+    data = es["data"]
+    model = make_model(cfg)
+    mesh = make_mesh(8, 1)
+    rates_vec = np.asarray(cfg["model_rate"], np.float32)
+    users = np.stack([
+        np.asarray(round_users(jax.random.fold_in(HOST_KEY, 1 + r), U, 4))
+        for r in range(2)])
+    rates = rates_vec[users]
+
+    g1 = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    p1 = model.init(jax.random.key(0))
+    p1, pend = g1.train_superstep(p1, HOST_KEY, 1, 2, users, rates, data)
+    pend.fetch()
+    ev = Evaluator(model, cfg, mesh, seed=0)
+    bn = ev.sbn_stats(p1, *es["sbn"])
+    local = ev.eval_users(p1, bn, *es["local"], epoch=2)
+    g = ev.eval_global(p1, bn, *es["global"], epoch=2)
+
+    g2 = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    p2 = model.init(jax.random.key(0))
+    p2, pend = g2.train_superstep(p2, HOST_KEY, 1, 2, users, rates, data,
+                                  eval_mask=(False, True),
+                                  fused_eval=_fused(model, cfg, mesh, es))
+    out = pend.fetch()
+    for name in p1:
+        np.testing.assert_array_equal(np.asarray(p1[name]), np.asarray(p2[name]),
+                                      err_msg=name)
+    _assert_evals_bitwise([(2, bn, local, g)], out["eval"])
+
+
+@pytest.mark.slow
+def test_evalfused_lm_global_only(eval_setup):
+    """LM path: no sBN, no Local eval -- the fused phase is the Global pass
+    alone, bitwise vs eval_global (the LM train scan itself is pinned
+    near-exact in test_superstep)."""
+    from test_round import _lm_setup
+
+    cfg, data = _lm_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(2, 1)
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, cfg["num_tokens"], size=(2, 2, 48)).astype(np.int64)
+    w = np.ones(rows.shape, np.float32)
+
+    eng1 = RoundEngine(model, cfg, mesh)
+    p1 = model.init(jax.random.key(0))
+    p1, pend = eng1.train_superstep(p1, HOST_KEY, 1, 2, data, num_active=4)
+    pend.fetch()
+    ev = Evaluator(model, cfg, mesh, seed=0)
+    g = ev.eval_global(p1, {}, rows, w, epoch=2)
+
+    ev2 = Evaluator(model, cfg, mesh, seed=0)
+    fe = ev2.fused(global_eval=(rows, w))
+    eng2 = RoundEngine(model, cfg, mesh)
+    p2 = model.init(jax.random.key(0))
+    p2, pend = eng2.train_superstep(p2, HOST_KEY, 1, 2, data, num_active=4,
+                                    eval_mask=(False, True), fused_eval=fe)
+    out = pend.fetch()
+    fe_out = out["eval"][0]
+    assert fe_out["local"] == {} and fe_out["bn"] == {}
+    for nm in g:
+        np.testing.assert_allclose(g[nm], fe_out["global"][nm], rtol=1e-6,
+                                   err_msg=nm)
+
+
+# ---------------------------------------------------------------------------
+# zero implicit H2D per eval window + flat program cache in steady state
+# ---------------------------------------------------------------------------
+
+def test_evalfused_transfer_guard_and_cache_flat_masked(eval_setup):
+    """The ISSUE 4 acceptance: with eval firing every round, steady-state
+    supersteps are ONE jitted dispatch each -- no implicit H2D under the
+    transfer guard (the eval operands are committed once) and zero program
+    cache growth."""
+    es = eval_setup
+    cfg, data = es["cfg"], es["data"]
+    model = make_model(cfg)
+    mesh = make_mesh(8, 1)
+    eng = RoundEngine(model, cfg, mesh)
+    fe = _fused(model, cfg, mesh, es)
+    p = model.init(jax.random.key(0))
+    p, pend = eng.train_superstep(p, HOST_KEY, 1, 2, data, num_active=4,
+                                  eval_mask=(True, True), fused_eval=fe)
+    pend.fetch()
+    size0 = eng.program_cache_size()
+    with jax.transfer_guard_host_to_device("disallow"):
+        p, pend = eng.train_superstep(p, HOST_KEY, 3, 2, data, num_active=4,
+                                      eval_mask=(True, True), fused_eval=fe)
+        p, pend = eng.train_superstep(p, HOST_KEY, 5, 2, data, num_active=4,
+                                      eval_mask=(True, True), fused_eval=fe)
+    out = pend.fetch()
+    assert eng.program_cache_size() == size0
+    assert len(out["eval"]) == 2
+    assert np.isfinite(out["eval"][-1]["global"]["loss_sum"])
+
+
+@pytest.mark.parametrize("placement", ["span", "slices"])
+def test_evalfused_transfer_guard_grouped(eval_setup, placement):
+    es = eval_setup
+    cfg = dict(es["cfg"], level_placement=placement)
+    data = es["data"]
+    model = make_model(cfg)
+    grp = GroupedRoundEngine(cfg, make_mesh(8, 1))
+    fe = _fused(model, cfg, grp.mesh, es)
+    rates_vec = np.asarray(cfg["model_rate"], np.float32)
+
+    def sched(epoch0, k):
+        # a count-stable schedule (same per-level membership every round) so
+        # the flat-cache assertion sees steady state, not the documented
+        # slot-bucket recompile that fluctuating level counts trigger
+        users = np.stack([np.array([0, 2, 4, 6], np.int32)] * k)
+        return users, rates_vec[users]
+
+    p = model.init(jax.random.key(0))
+    u, r = sched(1, 2)
+    p, pend = grp.train_superstep(p, HOST_KEY, 1, 2, u, r, data,
+                                  eval_mask=(True, True), fused_eval=fe)
+    pend.fetch()
+    size0 = grp.program_cache_size()
+    u3, r3 = sched(3, 2)
+    u5, r5 = sched(5, 2)
+    with jax.transfer_guard_host_to_device("disallow"):
+        p, pend = grp.train_superstep(p, HOST_KEY, 3, 2, u3, r3, data,
+                                      eval_mask=(True, True), fused_eval=fe)
+        p, pend = grp.train_superstep(p, HOST_KEY, 5, 2, u5, r5, data,
+                                      eval_mask=(True, True), fused_eval=fe)
+    out = pend.fetch()
+    assert grp.program_cache_size() == size0
+    assert np.isfinite(out["eval"][-1]["global"]["loss_sum"])
+
+
+def test_evalfused_donation_releases_previous_params(eval_setup):
+    """The eval-fused superstep still donates the params carry."""
+    es = eval_setup
+    cfg, data = es["cfg"], es["data"]
+    model = make_model(cfg)
+    mesh = make_mesh(1, 1)
+    eng = RoundEngine(model, cfg, mesh)
+    fe = _fused(model, cfg, mesh, es)
+    p0 = model.init(jax.random.key(0))
+    p1, pend = eng.train_superstep(p0, HOST_KEY, 1, 2, data, num_active=4,
+                                   eval_mask=(False, True), fused_eval=fe)
+    jax.block_until_ready(p1)
+    pend.fetch()
+    assert all(v.is_deleted() for v in p0.values())
+
+
+# ---------------------------------------------------------------------------
+# driver level: plateau-in-superstep + end-to-end at eval_interval=1
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_driver_end_to_end_eval_every_round(tmp_path):
+    """superstep_rounds=2 with eval_interval=1: every round evaluates inside
+    the scan; the driver still makes one dispatch per superstep and the
+    history carries one Global-Accuracy entry per round."""
+    from heterofl_tpu.entry import train_classifier_fed
+
+    ov = {"num_epochs": {"global": 4, "local": 1},
+          "conv": {"hidden_size": [8, 16]},
+          "batch_size": {"train": 10, "test": 20},
+          "superstep_rounds": 2, "eval_interval": 1, "strategy": "masked"}
+    argv = ["--control_name", "1_8_0.5_iid_fix_a1-b1-c1_bn_1_1",
+            "--data_name", "MNIST", "--model_name", "conv",
+            "--synthetic", "1",
+            "--synthetic_sizes", json.dumps({"train": 200, "test": 80}),
+            "--output_dir", str(tmp_path),
+            "--override", json.dumps(ov)]
+    res = train_classifier_fed.main(argv)
+    hist = res[0]["logger"].history
+    # 4 rounds in 2 supersteps -> 2 loop iterations; every round evaluated,
+    # so each iteration's mean covers that superstep's 2 evals
+    assert len(hist["test/Global-Accuracy"]) == 2
+    assert np.isfinite(hist["test/Global-Accuracy"]).all()
+    assert res[0]["bn_state"]  # the LAST fused eval's sBN stats landed
+
+
+@pytest.mark.slow
+def test_driver_end_to_end_plateau_superstep(tmp_path):
+    """ReduceLROnPlateau inside superstep mode (the ISSUE 4 relaxation):
+    LR rides as a per-superstep scalar and steps on the fused eval metrics
+    at superstep boundaries."""
+    from heterofl_tpu.entry import train_classifier_fed
+
+    ov = {"num_epochs": {"global": 4, "local": 1},
+          "conv": {"hidden_size": [8, 16]},
+          "batch_size": {"train": 10, "test": 20},
+          "superstep_rounds": 2, "eval_interval": 2,
+          "scheduler_name": "ReduceLROnPlateau", "strategy": "masked"}
+    argv = ["--control_name", "1_8_0.5_iid_fix_a1-b1_bn_1_1",
+            "--data_name", "MNIST", "--model_name", "conv",
+            "--synthetic", "1",
+            "--synthetic_sizes", json.dumps({"train": 160, "test": 80}),
+            "--output_dir", str(tmp_path),
+            "--override", json.dumps(ov)]
+    res = train_classifier_fed.main(argv)
+    hist = res[0]["logger"].history
+    assert len(hist["test/Global-Accuracy"]) == 2
+    assert np.isfinite(hist["train/Local-Loss"]).all()

@@ -141,6 +141,29 @@ def test_jit_donation_rule():
     assert [f.rule for f in fs] == ["jit-needs-donation"]
 
 
+def test_host_eval_in_driver_rule():
+    """ISSUE 4 satellite: host-side eval dispatch (sbn_stats / eval_users /
+    eval_global) in driver code is a lint finding -- the superstep fuses
+    those phases in-program -- escapable by pragma for the K=1 path."""
+    src = """
+    def run(exp, params, d):
+        bn = exp.evaluator.sbn_stats(params, d)
+        local = exp.evaluator.eval_users(params, bn, d)
+        return exp.evaluator.eval_global(params, bn, d)
+    """
+    fs = _lint(src, "heterofl_tpu/entry/common.py")
+    assert [f.rule for f in fs] == ["no-host-eval-in-driver"] * 3
+    # pragma escape (the K=1 host-loop path carries one per call)
+    assert _lint("""
+    def run(exp, params, d):
+        # staticcheck: allow(no-host-eval-in-driver): K=1 host-loop path
+        return exp.evaluator.eval_global(params, {}, d)
+    """, "heterofl_tpu/entry/common.py") == []
+    # scoped to the driver: engine/eval code and offline analysis are free
+    assert _lint(src, "heterofl_tpu/parallel/evaluation.py") == []
+    assert _lint(src, "heterofl_tpu/analysis/compare_reference.py") == []
+
+
 def test_repo_tree_is_lint_clean():
     """The gate itself: the shipped tree has zero unsuppressed findings."""
     fs = lint_tree(REPO, subdirs=["heterofl_tpu"])
@@ -222,6 +245,27 @@ def test_fused_superstep_single_global_psum(audit_report):
         assert set(p.collective_axes) <= {"clients", "data"}, name
 
 
+def test_eval_fused_program_budgets(audit_report):
+    """ISSUE 4: the eval-fused superstep variants keep ONE training psum per
+    fused round, with the eval phase's joint (clients, data) reductions --
+    sBN moments + Global sums, 2 per traced eval point -- audited as their
+    own budget, and full donation coverage intact."""
+    from heterofl_tpu.staticcheck.audit import EVAL_PSUM_BUDGET
+
+    k = 8
+    expected = {"masked/replicated/k8-eval1": EVAL_PSUM_BUDGET * k,
+                "masked/replicated/k8-eval8": EVAL_PSUM_BUDGET,
+                "masked/sharded/k8-eval1": EVAL_PSUM_BUDGET * k,
+                "grouped/span/k8-eval1-fused": EVAL_PSUM_BUDGET * k,
+                "grouped/slices/k8-eval1-fused": EVAL_PSUM_BUDGET * k}
+    for name, want in expected.items():
+        p = audit_report.programs[name]
+        assert p.psum_clients == 1, name
+        assert p.psum_eval == want, (name, p.psum_eval)
+        assert p.all_gather == 0, name
+        assert p.aliased == p.donation_expected > 0, name
+
+
 def test_donation_coverage_both_engines_both_placements(audit_report):
     """Every program that carries the params donates ALL param leaves and
     every donated leaf is consumed by input-output aliasing."""
@@ -240,7 +284,8 @@ def test_recompile_hazard_flat(audit_report):
     rc = audit_report.recompile
     assert rc["ok"], rc
     for which in ("masked_round", "masked_superstep",
-                  "masked_sharded_superstep", "grouped_round"):
+                  "masked_sharded_superstep", "masked_superstep_eval",
+                  "grouped_round"):
         assert rc[which]["after_repeat"] == rc[which]["after_warm"], (which, rc)
 
 
