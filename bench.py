@@ -29,6 +29,17 @@ extra.strategies carries `<engine>+eval-fused` vs `<engine>+eval-host`
 rows, the host row paying the PR 2 clamp (dispatch windows shortened to
 min(K, E)) plus a host `eval` phase per window.
 
+MFU (ISSUE 5): extra.mfu reports the analytic FLOPs/round from
+fed.core.level_flop_table (expected over the uniform active-client draw)
+and, when BENCH_PEAK_FLOPS is set (the hardware peak in FLOP/s, e.g.
+2.75e14 for one v4 chip in bf16 x devices), the achieved model FLOP
+utilisation mfu = flops_per_round * rounds_per_sec / peak.
+BENCH_STEP_AB=1 additionally records the fused-epilogue vs reference-chain
+step A/B into extra.step_ab: both measured with the shared procedure plus
+the optimized-HLO scan-body kernel counts of the primary engine's hot
+program (cfg['fused_update'] on vs off; the staticcheck step-body budget
+gates the same counts).
+
 'value' is like-for-like across strategies: the average per-round seconds
 over timed rounds EXCLUDING rounds that compiled a fresh program shape
 (grouped slot-bucket compiles, superstep shape changes; detected via
@@ -399,18 +410,46 @@ def main():
     strategy = os.environ.get("BENCH_STRATEGY", "masked")
     rates_vec = np.asarray(cfg["model_rate"], np.float32)
 
-    def make_engine(strat):
+    def make_engine(strat, cfg_over=None):
+        c = cfg if not cfg_over else dict(cfg, **cfg_over)
         if strat == "grouped":
             from heterofl_tpu.parallel import GroupedRoundEngine
 
-            return GroupedRoundEngine(cfg, mesh)
-        return RoundEngine(model, cfg, mesh)
+            return GroupedRoundEngine(c, mesh)
+        return RoundEngine(model, c, mesh)
 
     engine = make_engine(strategy)
     data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
     hb(f"data staged + engine built (strategy {strategy})")
 
     n_active = int(np.ceil(cfg["frac"] * users))
+    # MFU account (ISSUE 5): analytic FLOPs per round from the ONE level
+    # FLOP source of truth (fed.core.level_flop_table -- the same table the
+    # staticcheck FLOP budget and scripts/grouped_flops.py consume),
+    # expected over the uniform active-client draw; BENCH_PEAK_FLOPS (the
+    # hardware peak in FLOP/s) turns it into achieved utilisation.
+    from heterofl_tpu.fed.core import level_flop_table
+
+    flop_table = level_flop_table(cfg)
+    local_steps = cfg["num_epochs"]["local"] * int(
+        np.ceil(x.shape[1] / cfg["batch_size"]["train"]))
+    flops_per_round = n_active * local_steps * float(
+        np.mean([flop_table[float(r)] for r in rates_vec]))
+    try:
+        peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS") or 0) or None
+    except ValueError:
+        print(f"bench: ignoring malformed BENCH_PEAK_FLOPS="
+              f"{os.environ['BENCH_PEAK_FLOPS']!r}", file=sys.stderr)
+        peak_flops = None
+
+    def mfu_extra(rps):
+        out = {"analytic_flops_per_round": flops_per_round,
+               "source": "fed.core.level_flop_table",
+               "peak_flops": peak_flops}
+        if peak_flops:
+            out["mfu"] = round(flops_per_round * rps / peak_flops, 6)
+        return out
+
     # stage/dispatch/compute/fetch attribution for every timed round, plus
     # BENCH_FETCH_EVERY>1 to pipeline the D2H metric fetch behind the next
     # round's dispatch (parallel/staging.py; default 1 = synchronous parity)
@@ -604,6 +643,8 @@ def main():
             summary["rounds_per_dispatch"] = k_disp
         return summary, ctx
 
+    step_ab = {}  # filled by the BENCH_STEP_AB pass; emitted when non-empty
+
     def emit(ctx, rounds_done, strategies=None):
         # a degraded (non-flagship-volume / wrong-platform) run must not
         # pretend to be comparable to the 10 rps north star (VERDICT r4
@@ -631,6 +672,7 @@ def main():
                       "active_clients": n_active, "users": users,
                       "n_train": n_train, "final_loss": round(loss, 4),
                       "strategy": strategy,
+                      "mfu": mfu_extra(rps),
                       "compile_cache": {
                           "enabled": bool(cache_dir),
                           "requests": cache_counters["requests"],
@@ -642,6 +684,7 @@ def main():
                       **({"fetch_every": fetch_every,
                           "final_loss_round": ctx["ms_round"]} if fetch_every != 1 else {}),
                       **({"strategies": strategies} if strategies else {}),
+                      **({"step_ab": step_ab} if step_ab else {}),
                       **({"degraded": degraded} if degraded else {})},
         }), flush=True)
 
@@ -716,6 +759,68 @@ def main():
         strategies[alt] = try_measure(alt, f"[{alt}] ")
     if strategies:
         emit(ctx, timed_rounds, strategies=strategies)
+
+    # BENCH_STEP_AB=1 (ISSUE 5): fused-epilogue vs reference-op-chain step
+    # A/B -- both arms measured with the SAME shared procedure (plain train
+    # windows; eval rides the primary record, not this one), plus the
+    # optimized-HLO scan-body kernel counts in both modes.  The counted
+    # program is the engine's K=1 hot program at the bench shapes (masked:
+    # the one-round train program; grouped: the full-width level-a span
+    # program) -- its LOCAL-STEP scan body is the same step body the
+    # K-round superstep scans, and the same body the staticcheck budget
+    # gates; the record labels which program was lowered.  Failures never
+    # kill the primary record.
+    if os.environ.get("BENCH_STEP_AB") == "1":
+        try:
+            from heterofl_tpu.staticcheck.jaxpr_walk import scan_body_kernel_count
+
+            psds = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), dict(params))
+
+            def body_counts(fused):
+                eng = make_engine(strategy, {"fused_update": fused})
+                lr0 = np.float32(0.1)
+                if strategy == "grouped":
+                    from heterofl_tpu.parallel.grouped import _bucket_pow2
+
+                    slots = _bucket_pow2(1) * len(devs)
+                    sds = jax.ShapeDtypeStruct((slots,), np.int32)
+                    low = eng._level_prog(1.0, slots).lower(
+                        psds, base_key, lr0, sds, *data)
+                    prog_name = "grouped/span/level-1/k1"
+                else:
+                    fix = (eng.fix_rates,) if eng.fix_rates is not None else ()
+                    slots = users + ((-users) % len(devs))
+                    sds = jax.ShapeDtypeStruct((slots,), np.int32)
+                    low = eng._build_train().lower(
+                        psds, base_key, lr0, sds, sds, *(data + fix))
+                    prog_name = "masked/k1"
+                return {"program": prog_name,
+                        **scan_body_kernel_count(low.compile().as_text())}
+
+            hb("[step-ab] measuring fused vs reference epilogue")
+            ab_fused, _ = measure(strategy, make_engine(strategy),
+                                  model.init(jax.random.key(0)), PhaseTimer(),
+                                  hb_prefix="[step-ab/fused] ")
+            ab_ref, _ = measure(strategy,
+                                make_engine(strategy, {"fused_update": False}),
+                                model.init(jax.random.key(0)), PhaseTimer(),
+                                hb_prefix="[step-ab/reference] ")
+            kf, kr = body_counts(True), body_counts(False)
+            step_ab.update({
+                "fused": ab_fused,
+                "reference": ab_ref,
+                "speedup": round(ab_ref["round_sec_steady_avg"]
+                                 / ab_fused["round_sec_steady_avg"], 4),
+                "scan_body_kernels": {
+                    "fused": kf, "reference": kr,
+                    "fusion_drop_pct": round(
+                        100.0 * (1.0 - kf["fusions"] / max(1, kr["fusions"])), 1)},
+            })
+        except Exception as e:
+            step_ab.update({"error": repr(e)})
+            print(f"bench: step A/B failed: {e!r}", file=sys.stderr)
+        emit(ctx, timed_rounds, strategies=strategies or None)
 
 
 if __name__ == "__main__":

@@ -105,6 +105,23 @@ DEFAULT_CFG: Dict[str, Any] = {
     # lax.scan unroll factor for the local-step loop (1 = no unrolling);
     # latency-bound rounds can gain from fewer loop trips, A/B in tpu_ab.py
     "scan_unroll": 1,
+    # fused masked-SGD optimizer epilogue + flat scan carry
+    # (ops/fused_update.py): collapse the per-step grad normalise/mask/clip/
+    # momentum/update/has-gate tail into one fused primitive and carry
+    # params/momentum through the local-step scan as single lane-packed
+    # buffers.  True = Pallas TPU kernel on TPU, flat-carry XLA fallback
+    # elsewhere; False = the seed program (tree carry + reference op chain);
+    # "xla"/"pallas" force an implementation.  The primitive and the
+    # engines' STEP results are bit-identical to the reference chain
+    # (tests/test_fused_update.py); long multi-step trajectories agree at
+    # float-association level, like the masked-vs-sliced engine contract.
+    # Non-SGD optimizers always use the reference chain.
+    "fused_update": True,
+    # explicit layout policy (models/layout.py): "auto" pins the params
+    # carry's device layouts (row-major; width axes lane-packed minor-most)
+    # at the program boundary on TPU backends and passes through on CPU;
+    # "pinned" forces the pin, "none" disables it.
+    "layout_policy": "auto",
     "param_dtype": "float32",
     "compute_dtype": "float32",  # set "bfloat16" to run matmuls/convs in bf16
     "mesh": {"clients": 0, "data": 1},  # 0 => use all available devices

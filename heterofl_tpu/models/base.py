@@ -41,6 +41,8 @@ class ModelDef:
 
 def uniform_fan_in(key: jax.Array, shape, fan_in: int) -> jnp.ndarray:
     """torch's default kaiming_uniform(a=sqrt(5)): U(-1/sqrt(fan_in), +)."""
+    # staticcheck: allow(no-asarray, no-float-coercion): init-time static
+    # fan-in scalar, never on the round path
     bound = 1.0 / jnp.sqrt(jnp.asarray(float(fan_in)))
     return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
 

@@ -36,6 +36,7 @@ class Group:
     def active_count(self, width_rate) -> jnp.ndarray:
         """Number of active entries for a client at ``width_rate``."""
         if self.kind == "full":
+            # staticcheck: allow(no-asarray): trace-time static group size
             return jnp.asarray(self.size, jnp.int32)
         if self.kind == "prefix":
             return jnp.ceil(self.size * width_rate).astype(jnp.int32)

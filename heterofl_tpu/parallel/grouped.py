@@ -65,6 +65,7 @@ from jax.sharding import PartitionSpec as P
 from ..fed.core import (combine_counted, embed_sliced_jnp, extract_sliced_jnp,
                         level_flop_table, snap_to_levels)
 from ..models import make_model
+from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
 from ..utils.optim import make_traced_lr_fn
 from .round_engine import RoundEngine, _bucket_pow2, _ceil_div, _shard_map
@@ -96,6 +97,9 @@ class GroupedRoundEngine:
             raise ValueError(f"Not valid level_placement: {self.level_placement!r}")
         self.global_rate = cfg["global_model_rate"]
         self.global_model = make_model(cfg)
+        # layout pinning (ISSUE 5 pass 2), same cached pinner as the
+        # masked engine
+        self._pin = ParamPinner(mesh, cfg.get("layout_policy", "auto"))
         self.is_lm = self.global_model.meta.get("kind") == "transformer"
         self.failure_rate = float(cfg.get("client_failure_rate", 0.0) or 0.0)  # staticcheck: allow(no-float-coercion): constructor-time config scalar
         self.levels: Dict[float, Tuple[Any, RoundEngine]] = {}
@@ -335,8 +339,8 @@ class GroupedRoundEngine:
             # commit the globals once: an uncommitted init tree would give
             # every level program AND the combine a second specialization on
             # round 2, when the combined outputs come back mesh-committed
-            # (staticcheck recompile audit)
-            global_params = self._staging.commit(global_params)
+            # (staticcheck recompile audit); layout pinned by the same policy
+            global_params = self._staging.commit(self._pin(global_params))
 
         sums, cnts, ms_levels, positions = [], [], [], []
         for rate in level_order:
@@ -629,8 +633,8 @@ class GroupedRoundEngine:
             lr_args = (self._staging.scalar(lr),) if lr_arg else ()
             eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
             epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
-            # commit the params carry (see train_round)
-            global_params = self._staging.commit(global_params)
+            # commit the params carry (see train_round), layout pinned
+            global_params = self._staging.commit(self._pin(global_params))
             prog = self._superstep_prog(k, per_dev, mode, eval_mask=eval_mask,
                                         fused_eval=fused_eval, lr_arg=lr_arg)
         with timer.phase("dispatch"):

@@ -40,8 +40,10 @@ from ..fed.core import combine_counted, round_rates, round_users
 from .ring_attention import ring_attention
 from .staging import PendingMetrics, PhaseTimer, PlacementCache, SlotPacker
 from ..models.base import ModelDef
+from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks, mask_params, param_mask
 from ..ops.augment import augment_cifar, normalize_image
+from ..ops.fused_update import FlatSpec, fused_sgd_flat, resolve_fused_mode
 from ..utils.optim import clip_by_global_norm, make_optimizer, make_traced_lr_fn
 
 
@@ -212,6 +214,21 @@ class RoundEngine:
         # overhead; 1 = no unrolling (identical program)
         self.scan_unroll = int(cfg.get("scan_unroll", 1) or 1)
         self._opt_init, self._opt_update = make_optimizer(cfg)
+        # fused masked-SGD epilogue (ISSUE 5 tentpole): None = the reference
+        # op chain; 'xla'/'pallas' = ops/fused_update.py.  Resolved once at
+        # construction so the scan body is shape-stable per engine.
+        self._fused_mode = resolve_fused_mode(cfg)
+        self._momentum = cfg.get("momentum", 0.0)
+        self._weight_decay = cfg.get("weight_decay", 0.0)
+        # debug/regression knob: re-materialise the per-param grad masks
+        # inside the scan body (the pre-hoist program) -- exists so the
+        # staticcheck step-body budget can prove it catches the regression
+        self._masks_in_body = bool(cfg.get("_masks_in_body", False))
+        # layout pinning (ISSUE 5 pass 2): commit the params carry with the
+        # models/layout.py policy so the superstep scan carry enters every
+        # dispatch in the compute layout (TPU; identity on the CPU mesh);
+        # the pinner caches the static Format tree across dispatches
+        self._pin = ParamPinner(mesh, cfg.get("layout_policy", "auto"))
         self._train = None
         self._superstep_progs: Dict[Tuple, Any] = {}
         self._lr_fn = None  # built on first superstep (plateau raises there)
@@ -239,6 +256,78 @@ class RoundEngine:
             img = x_u8.astype(jnp.float32)
         return img
 
+    def _grad_masks(self, shapes, wr):
+        """Per-param width-activity masks for the gradient epilogue.
+
+        Loop-INVARIANT: they depend only on (shape, spec, wr), all fixed for
+        one client's whole local run, so the callers hoist them OUT of the
+        ``lax.scan`` step body (ISSUE 5 satellite) -- the seed program
+        re-materialised every mask (iota + compare + broadcast per sliced
+        axis per leaf) 250 times per round.  The staticcheck step-body
+        kernel budget regression-tests the hoist."""
+        model = self.model
+        return {k: param_mask(shape, model.specs[k], model.groups, wr)
+                for k, shape in shapes.items()}
+
+    def _local_setup(self, p, wr):
+        """(scan-carry params, opt state, FlatSpec-or-None, epilogue masks)
+        for one client's local run.
+
+        With the fused epilogue on, the params and momentum buffers ride
+        the ``lax.scan`` carry as ONE lane-packed flat f32 buffer each
+        (ops/fused_update.py FlatSpec) -- the carry shrinks from O(leaves)
+        loop-carried buffers to O(1) with a pinned packed layout, the model
+        fwd/bwd consumes zero-copy leaf views unflattened inside the step
+        (and is differentiated w.r.t. those views, so the per-leaf grads
+        and norm terms are the reference chain's), and the optimizer tail
+        runs in the flat domain.  ``masks`` are the hoisted loop-invariant
+        grad masks, or None under the ``_masks_in_body`` regression
+        knob."""
+        gmasks = None if self._masks_in_body else \
+            self._grad_masks({k: v.shape for k, v in p.items()}, wr)
+        if self._fused_mode is None:
+            return p, self._opt_init(p), None, gmasks
+        spec = FlatSpec.of(p)
+        pf = spec.flatten(p)
+        # the fused opt state is JUST the flat momentum buffer: SGD never
+        # reads the OptState step counter, so carrying it through the scan
+        # would be a dead loop-carried value
+        return pf, jnp.zeros_like(pf), spec, gmasks
+
+    def _apply_update(self, p, grads, opt, masks, spec, wr, n_glob, lr,
+                      has=None):
+        """The per-step optimizer epilogue: mean-normalise + width-mask +
+        global-norm clip + optimizer update (+ ``has`` gating for
+        all-padding batches).
+
+        ``spec`` non-None selects the fused masked-SGD primitive over the
+        flat carry (ops/fused_update.py -- Pallas on TPU, flat XLA fallback
+        elsewhere, both bit-identical to this reference chain on the clip
+        decision and elementwise tail); None keeps the reference op chain
+        (non-SGD optimizers always do).  ``masks=None`` re-materialises
+        the masks here, inside the scan body (the ``_masks_in_body``
+        regression knob)."""
+        if spec is not None:
+            if masks is None:
+                masks = self._grad_masks(spec.shapes, wr)
+            return fused_sgd_flat(
+                spec, p, grads, opt, masks, n_glob, lr,
+                momentum=self._momentum, weight_decay=self._weight_decay,
+                has=has, mode=self._fused_mode)
+        if masks is None:
+            masks = self._grad_masks({k: g.shape for k, g in grads.items()}, wr)
+        grads = {k: g / jnp.maximum(n_glob, 1e-6) for k, g in grads.items()}
+        grads = {k: g * masks[k] for k, g in grads.items()}
+        grads, _ = clip_by_global_norm(grads, 1.0)
+        p_new, opt_new = self._opt_update(p, grads, opt, lr)
+        if has is not None:
+            # all-padding batch: skip the step entirely (no wd/momentum drift)
+            p_new = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(has, a, b), p_new, p)
+            opt_new = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(has, a, b), opt_new, opt)
+        return p_new, opt_new
+
     def _local_train_vision(self, params, wr, x, y, sm, lm, key, lr, scaler_rate=None,
                             data_axis=None, n_data: int = 1):
         """Local SGD for one client.
@@ -255,7 +344,7 @@ class RoundEngine:
         SB = S * B
         sr = wr if scaler_rate is None else scaler_rate
         p = mask_params(params, model.specs, model.groups, wr)
-        opt = self._opt_init(p)
+        p, opt, spec, emasks = self._local_setup(p, wr)
         ekeys = jax.random.split(jax.random.fold_in(key, 1), E)
         # Shuffle, then stable-sort the *real* samples (sm==1) to the front:
         # batches are dense like the reference's DataLoader over the true
@@ -298,8 +387,8 @@ class RoundEngine:
             img = self._prep_vision_batch(x[ids], w, aug_key)
             batch = {"img": img, "label": y[ids]}
 
-            def loss_fn(p):
-                out, _ = model.apply(p, batch, train=True, width_rate=wr, scaler_rate=sr,
+            def loss_fn(pt):
+                out, _ = model.apply(pt, batch, train=True, width_rate=wr, scaler_rate=sr,
                                      label_mask=lm, sample_weight=w,
                                      rng=jax.random.fold_in(key, 5000 + t),
                                      bn_axis=data_axis if n_data > 1 else None)
@@ -308,24 +397,24 @@ class RoundEngine:
                 # exact full-batch mean gradient
                 return out["loss"] * n_loc, out["score"]
 
-            (lsum, score), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            # under the fused flat carry the model is differentiated w.r.t.
+            # the per-leaf VIEWS, so grads come back per-leaf -- the norm
+            # terms then reduce over the reference chain's exact arrays
+            (lsum, score), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                spec.unflatten(p) if spec is not None else p)
             correct = jnp.sum((jnp.argmax(score, -1) == y[ids]) * w)
             if data_axis is not None and n_data > 1:
                 grads, lsum, correct = jax.lax.psum((grads, lsum, correct), data_axis)
-            grads = {k: g / jnp.maximum(n_glob, 1e-6) for k, g in grads.items()}
-            grads = {k: g * param_mask(g.shape, model.specs[k], model.groups, wr)
-                     for k, g in grads.items()}
-            grads, _ = clip_by_global_norm(grads, 1.0)
-            p_new, opt_new = self._opt_update(p, grads, opt, lr)
-            # all-padding batch: skip the step entirely (no wd/momentum drift)
-            p = jax.tree_util.tree_map(lambda a, b: jnp.where(has, a, b), p_new, p)
-            opt = jax.tree_util.tree_map(lambda a, b: jnp.where(has, a, b), opt_new, opt)
+            p, opt = self._apply_update(p, grads, opt, emasks, spec, wr,
+                                        n_glob, lr, has=has)
             acc = (acc[0] + lsum, acc[1] + correct, acc[2] + n_glob)
             return (p, opt, acc), None
 
         acc0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
         (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S),
                                       unroll=self.scan_unroll)
+        if spec is not None:
+            p = spec.unflatten(p)
         return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
 
     def _local_train_lm(self, params, wr, rows, lm, key, lr, scaler_rate=None,
@@ -347,7 +436,7 @@ class RoundEngine:
         rows_p = jnp.pad(rows, ((0, 0), (0, pad)))
         wpos = jnp.pad(jnp.ones((R, T), jnp.float32), ((0, 0), (0, pad)))
         p = mask_params(params, model.specs, model.groups, wr)
-        opt = self._opt_init(p)
+        p, opt, spec, emasks = self._local_setup(p, wr)
 
         seq_sharded = data_axis is not None and n_data > 1
         if seq_sharded:
@@ -372,8 +461,8 @@ class RoundEngine:
                 batch = {"label": lab, "pos_offset": off, "seq_full": bptt}
                 extra = {"attn_override": lambda q, k, v, temp: attn(q, k, v, temperature=temp)}
 
-            def loss_fn(p):
-                out, _ = model.apply(p, batch, train=True, width_rate=wr,
+            def loss_fn(pt):
+                out, _ = model.apply(pt, batch, train=True, width_rate=wr,
                                      scaler_rate=sr, label_mask=lm, sample_weight=w,
                                      rng=jax.random.fold_in(key, 5000 + t), **extra)
                 # weighted-SUM form so the cross-shard reduction recovers the
@@ -381,17 +470,16 @@ class RoundEngine:
                 n_loc = jnp.sum(w)
                 return out["loss"] * n_loc, n_loc
 
-            (lsum, n_loc), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            # per-leaf grads even under the flat carry (see _local_train_vision)
+            (lsum, n_loc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                spec.unflatten(p) if spec is not None else p)
             if seq_sharded:
                 grads, lsum, n_glob = jax.lax.psum((grads, lsum, n_loc), data_axis)
             else:
                 n_glob = n_loc
             loss = lsum / jnp.maximum(n_glob, 1e-6)
-            grads = {k: g / jnp.maximum(n_glob, 1e-6) for k, g in grads.items()}
-            grads = {k: g * param_mask(g.shape, model.specs[k], model.groups, wr)
-                     for k, g in grads.items()}
-            grads, _ = clip_by_global_norm(grads, 1.0)
-            p, opt = self._opt_update(p, grads, opt, lr)
+            p, opt = self._apply_update(p, grads, opt, emasks, spec, wr,
+                                        n_glob, lr)
             # Logger weight: rows per window (ref train_transformer_fed.py
             # appends with input['label'].size(0)); Perplexity = exp(window CE).
             n = np.float32(R)  # static trace-time constant, not a device wrap
@@ -401,6 +489,8 @@ class RoundEngine:
         acc0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
         (p, _, acc), _ = jax.lax.scan(step, (p, opt, acc0), jnp.arange(E * S),
                                       unroll=self.scan_unroll)
+        if spec is not None:
+            p = spec.unflatten(p)
         return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
 
     # ------------------------------------------------------------------
@@ -688,8 +778,9 @@ class RoundEngine:
             epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
             # commit the params carry: an uncommitted init tree would
             # specialise this program once and recompile on round 2 when the
-            # outputs come back mesh-committed (staticcheck recompile audit)
-            params = self._staging.commit(params)
+            # outputs come back mesh-committed (staticcheck recompile audit);
+            # the layout pin rides the same commit (models/layout.py policy)
+            params = self._staging.commit(self._pin(params))
             pkey = (k, per_dev, in_jit, a, eval_mask, lr_arg)
             prog = self._superstep_progs.get(pkey)
             if prog is None:
@@ -785,7 +876,8 @@ class RoundEngine:
             ug = self._staging.put(user_glob, spec=P("clients"))
             ul = ug if user_loc is user_glob else self._staging.put(user_loc, spec=P("clients"))
             # commit params so dispatch 1 and the steady state share ONE
-            # program specialization (see train_superstep)
-            params = self._staging.commit(params)
+            # program specialization (see train_superstep); layout pinned
+            # by the same policy
+            params = self._staging.commit(self._pin(params))
         with timer.phase("dispatch"):
             return self._train(params, key, lr, ul, ug, *args)

@@ -43,7 +43,7 @@ import numpy as np
 
 from .jaxpr_walk import (aliased_outputs, count_collectives, count_psum_joint,
                          count_psum_over, donation_marks, find_callbacks,
-                         find_f64)
+                         find_f64, scan_body_kernel_count)
 from .report import AuditReport, Finding, ProgramReport
 
 #: FLOP-share tolerance (max relative error of measured vs analytic level
@@ -61,6 +61,20 @@ PSUM_BUDGET = 1
 #: eval point's trace (the per-user Local sums stay sharded -- no
 #: collective)
 EVAL_PSUM_BUDGET = 2
+
+#: the ISSUE 5 hot-step kernel budget: max fusion launches per iteration of
+#: the LOCAL-STEP scan body (optimized HLO, CPU-mesh lowering) for the two
+#: programs on the level-a critical path.  Sized from the fused-epilogue
+#: bodies (masked 55, grouped level-a 61 at the audit widths; the flagship
+#: ResNet-18 body drops 415 -> 304) with headroom, and BELOW the
+#: reference-op-chain bodies (72 / 76) -- so an op-soup regression
+#: (un-hoisting the masks + un-fusing the epilogue, or any new per-leaf
+#: chain of comparable size) fails the audit the same way a second psum
+#: would.
+STEP_BODY_FUSION_BUDGET = {
+    "masked/replicated/k1": 60,
+    "grouped/span/level-1/k1": 66,
+}
 
 
 def default_audit_cfg(flagship: bool = False) -> Dict[str, Any]:
@@ -388,6 +402,19 @@ def audit_program(name: str, prog, args: Tuple, expect: Dict[str, Any],
 
     lowered_text = lowered.as_text()
     compiled_text = compiled.as_text()
+    # hot-step kernel count (ISSUE 5): recorded for EVERY program, budgeted
+    # on the level-a critical-path bodies (STEP_BODY_FUSION_BUDGET)
+    rep.step_body = scan_body_kernel_count(compiled_text)
+    rep.step_body_budget = expect.get("step_body_fusions",
+                                      STEP_BODY_FUSION_BUDGET.get(name))
+    if rep.step_body_budget is not None \
+            and rep.step_body["fusions"] > rep.step_body_budget:
+        rep.fail("step-body-budget",
+                 f"{rep.step_body['fusions']} fusion kernels per scan-body "
+                 f"iteration (body {rep.step_body['body']}), budget is "
+                 f"{rep.step_body_budget}: the per-step op soup has "
+                 f"regressed (un-hoisted masks / un-fused epilogue / a new "
+                 f"per-leaf chain)")
     rep.donated = donation_marks(lowered_text)
     rep.aliased = aliased_outputs(compiled_text)
     if rep.donated != expect["donated"]:

@@ -145,6 +145,63 @@ def count_psum_joint(jaxpr, axes: Tuple[str, ...] = ("clients", "data")) -> int:
 
 
 # ---------------------------------------------------------------------------
+# optimized-HLO computation parsing: the step-body kernel count
+# ---------------------------------------------------------------------------
+
+def hlo_computations(compiled_text: str) -> dict:
+    """``{computation_name: block_text}`` of an optimized HLO module dump.
+
+    Computations start at column 0 (``%name (params) -> type {`` or
+    ``ENTRY ...``) and end at a column-0 ``}``."""
+    blocks, name, buf = {}, None, []
+    for line in compiled_text.splitlines():
+        if not line.startswith(" ") and "{" in line and name is None:
+            m = re.search(r"%?([\w\.\-]+)\s*\(", line)
+            if m:
+                name = m.group(1)
+                buf = [line]
+        elif name is not None:
+            buf.append(line)
+            if line.startswith("}"):
+                blocks[name] = "\n".join(buf)
+                name = None
+    return blocks
+
+
+def while_body_stats(compiled_text: str) -> dict:
+    """Per-while-loop-body kernel stats of an optimized HLO module:
+    ``{body_name: {"fusions": n, "instructions": m}}``.
+
+    ``fusions`` counts fusion-instruction launches inside the body -- the
+    CPU/TPU proxy for per-iteration kernel count; ``instructions`` is the
+    body's total op count.  Scans lower to whiles, so the LOCAL-STEP body
+    of a round program is one of these (in practice the largest)."""
+    blocks = hlo_computations(compiled_text)
+    out = {}
+    for body in set(re.findall(r"body=%?([\w\.\-]+)", compiled_text)):
+        blk = blocks.get(body)
+        if blk is None:
+            continue
+        out[body] = {
+            "fusions": len(re.findall(r"= \S+ fusion\(", blk)),
+            "instructions": len(re.findall(r"^\s+\S+ = ", blk, re.M)),
+        }
+    return out
+
+
+def scan_body_kernel_count(compiled_text: str) -> dict:
+    """Kernel stats of THE scan body -- the largest while body by
+    instruction count (the local-step loop dominates every round program;
+    smaller whiles are bookkeeping).  ``{"fusions": n, "instructions": m,
+    "body": name}``; zeros when the program has no loop."""
+    stats = while_body_stats(compiled_text)
+    if not stats:
+        return {"fusions": 0, "instructions": 0, "body": None}
+    body = max(stats, key=lambda b: stats[b]["instructions"])
+    return {**stats[body], "body": body}
+
+
+# ---------------------------------------------------------------------------
 # donation / aliasing, from the lowered & compiled IR text
 # ---------------------------------------------------------------------------
 

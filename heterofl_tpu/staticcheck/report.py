@@ -48,6 +48,11 @@ class ProgramReport:
     donation_expected: int = 0
     flops: Optional[float] = None
     memory: Optional[Dict[str, int]] = None
+    #: optimized-HLO kernel stats of the program's scan body (the local-step
+    #: loop): fusion launches + instruction count per iteration, and the
+    #: budget enforced against it (None = recorded, not budgeted)
+    step_body: Optional[Dict[str, Any]] = None
+    step_body_budget: Optional[int] = None
 
     def fail(self, rule: str, message: str) -> None:
         self.ok = False

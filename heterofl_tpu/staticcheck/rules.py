@@ -59,31 +59,35 @@ class Rule:
 
 
 _PARALLEL = ("heterofl_tpu/parallel/",)
-_TRACED = ("heterofl_tpu/parallel/", "heterofl_tpu/fed/")
+#: kernel/model hot-path code (ISSUE 5): ops/ and models/ run INSIDE the
+#: round programs, so the same banned-call rules apply -- trace-time
+#: constant coercions carry `allow` pragmas with their reasons
+_KERNEL = ("heterofl_tpu/ops/", "heterofl_tpu/models/")
+_TRACED = ("heterofl_tpu/parallel/", "heterofl_tpu/fed/") + _KERNEL
 _DRIVER = ("heterofl_tpu/entry/",)
 
 DEFAULT_RULES: Tuple[Rule, ...] = (
     Rule("no-asarray",
          "per-call asarray device/host wraps in steady-state code: commit "
          "operands once via the staging layer (PlacementCache) instead",
-         _PARALLEL,
+         _PARALLEL + _KERNEL,
          calls=("jax.numpy.asarray", "numpy.asarray")),
     Rule("no-block-until-ready",
          "host synchronisation on the round path: only the bench/driver "
          "boundary may block",
-         _PARALLEL,
+         _PARALLEL + _KERNEL,
          calls=("jax.block_until_ready",),
          methods=("block_until_ready",)),
     Rule("no-device-get",
          "implicit D2H on the round path: metric sums stay on device "
          "(PendingMetrics) until the caller fetches",
-         _PARALLEL,
+         _PARALLEL + _KERNEL,
          calls=("jax.device_get",),
          methods=("device_get",)),
     Rule("no-float-coercion",
          "float() on a device value blocks on the transfer; fetch through "
          "PendingMetrics / eval boundaries instead",
-         _PARALLEL,
+         _PARALLEL + _KERNEL,
          builtins=("float",)),
     Rule("no-wallclock",
          "wall-clock reads reachable from traced scopes poison program "

@@ -12,7 +12,15 @@ the grouped engine's slices row allocation).  CPU-safe: nothing is
 executed, only compiled.  Prints one JSON line; run under
 JAX_PLATFORMS=cpu with the axon env scrubbed (see tests/conftest.py).
 
-Usage: [SMALL=1] python scripts/grouped_flops.py   (SMALL=1: test widths)
+MFU column: set BENCH_PEAK_FLOPS (hardware peak in FLOP/s -- the SAME knob
+bench.py's extra.mfu consumes, e.g. 2.75e14 for one v4 chip in bf16 x
+devices) and the account gains `mfu`: the ideal round seconds at peak per
+engine (flops / peak) and the per-engine `mfu_x_round_sec` factor -- divide
+by a measured round time to get achieved utilisation, so the FLOP account
+and the bench speak one unit.
+
+Usage: [SMALL=1] [BENCH_PEAK_FLOPS=...] python scripts/grouped_flops.py
+       (SMALL=1: test widths)
 """
 
 import json
@@ -62,11 +70,28 @@ def main():
 
     t0 = time.time()
     account = flop_account(cfg, data, mesh, user_idx, rates_vec[user_idx])
+    mfu = None
+    try:
+        peak = float(os.environ.get("BENCH_PEAK_FLOPS") or 0) or None
+    except ValueError:
+        print(f"grouped_flops: ignoring malformed BENCH_PEAK_FLOPS="
+              f"{os.environ['BENCH_PEAK_FLOPS']!r}", file=sys.stderr)
+        peak = None
+    if peak:
+        # the FLOP-time floor per engine; divide by a MEASURED round time
+        # to get achieved MFU (bench.py's extra.mfu does exactly that with
+        # its own wall clock)
+        mfu = {"peak_flops": peak,
+               "ideal_round_sec_at_peak": {
+                   "masked": account["masked_flops_per_round"] / peak,
+                   "grouped": account["grouped_flops_per_round"] / peak},
+               "note": "mfu = ideal_round_sec_at_peak / measured_round_sec"}
     print(json.dumps({
         "config": f"CIFAR10 resnet18 {cfg['resnet']['hidden_size']} "
                   f"{users}u/10a a1-e1, batch {cfg['batch_size']['train']}, "
                   f"local_epochs {cfg['num_epochs']['local']}, bf16",
         **account,
+        **({"mfu": mfu} if mfu else {}),
         "compile_sec": round(time.time() - t0, 1),
     }), flush=True)
 

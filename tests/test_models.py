@@ -190,3 +190,65 @@ def test_augment_cifar_shapes_and_determinism():
     assert not np.array_equal(np.asarray(a1), np.asarray(a3))  # new key
     # crop+flip only rearranges pixels from the padded canvas
     assert np.asarray(a1).max() <= 255 and np.asarray(a1).min() >= 0
+
+
+# ---------------------------------------------------------------------------
+# the explicit layout/dtype policy (ISSUE 5 pass 2)
+# ---------------------------------------------------------------------------
+
+def test_layout_policy_every_family_compliant():
+    """Trailing axes are feature axes (width-group or label) for every
+    model family -- the lane-packing convention models/layout.py pins."""
+    from heterofl_tpu.models import layout as L
+
+    for name in ("conv", "resnet18", "resnet50", "transformer"):
+        cfg = small_cfg(name, data_name="WikiText2" if name == "transformer"
+                        else "MNIST")
+        model = make_model(cfg)
+        params = model.init(jax.random.key(0))
+        bad = L.check_policy(model.specs,
+                             {k: v.shape for k, v in params.items()})
+        assert bad == {}, (name, bad)
+
+
+def test_layout_policy_flags_transposed_weight():
+    """A torch-style [out, in] weight (reduction axis in the lanes) fails
+    the policy audit."""
+    from heterofl_tpu.models import layout as L
+    from heterofl_tpu.models.spec import ParamSpec
+
+    assert L.check_policy({"w": ParamSpec(axis_groups={0: "h"})},
+                          {"w": (8, 10)}) == {"w": 1}
+    assert L.check_policy({"w": ParamSpec(axis_groups={1: "h"})},
+                          {"w": (10, 8)}) == {}
+
+
+def test_pin_params_cpu_passthrough_and_formats():
+    """On the CPU test mesh pin_params is the identity (XLA:CPU ignores
+    custom layouts); the Format objects themselves pin row-major
+    major-to-minor, and an unknown policy raises."""
+    import pytest
+
+    from heterofl_tpu.models.layout import param_formats, pin_params
+
+    cfg = small_cfg("conv")
+    model = make_model(cfg)
+    params = model.init(jax.random.key(0))
+    pinned = pin_params(params, mesh=None, policy="auto")
+    assert all(pinned[k] is params[k] for k in params)
+    assert pin_params(params, mesh=None, policy="none") is params
+    with pytest.raises(ValueError, match="layout_policy"):
+        pin_params(params, mesh=None, policy="fastest")
+    fmts = param_formats(params)
+    for k, v in params.items():
+        dll = fmts[k].device_local_layout
+        assert tuple(dll.major_to_minor) == tuple(range(v.ndim)), k
+
+
+def test_conv_dimension_numbers_one_owner():
+    """The conv convention has one owner (ops/layers.py) and the layout
+    policy re-exports it."""
+    from heterofl_tpu.models.layout import CONV_DIMENSION_NUMBERS as A
+    from heterofl_tpu.ops.layers import CONV_DIMENSION_NUMBERS as B
+
+    assert A is B == ("NHWC", "HWIO", "NHWC")

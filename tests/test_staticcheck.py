@@ -76,7 +76,10 @@ def test_path_scoping():
     def f(a):
         return np.asarray(a)
     """
-    assert _lint(src, "heterofl_tpu/models/conv.py") == []
+    # ISSUE 5: ops/ and models/ are hot-path scope now (kernel/model code
+    # runs inside the round programs); analysis/ stays host-side
+    assert len(_lint(src, "heterofl_tpu/models/conv.py")) == 1
+    assert len(_lint(src, "heterofl_tpu/ops/kern.py")) == 1
     assert _lint(src, "heterofl_tpu/analysis/summary.py") == []
     assert len(_lint(src, "heterofl_tpu/parallel/engine.py")) == 1
     # nested checkouts still match (prefix anywhere after a slash)
@@ -443,3 +446,86 @@ def test_cli_full_audit_green_and_writes_artifact(tmp_path):
     assert rec["programs"]["grouped/slices/k8-fused"]["psum_clients"] == 1
     for name, p in rec["programs"].items():
         assert p["aliased"] == p["donation_expected"], name
+
+
+# ---------------------------------------------------------------------------
+# the hot-step kernel budget (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+def test_step_body_kernel_counts_recorded_and_budgeted(audit_report):
+    """Every audited program records its scan-body kernel stats; the two
+    level-a critical-path programs are held to STEP_BODY_FUSION_BUDGET."""
+    from heterofl_tpu.staticcheck.audit import STEP_BODY_FUSION_BUDGET
+
+    for name, budget in STEP_BODY_FUSION_BUDGET.items():
+        p = audit_report.programs[name]
+        assert p.step_body is not None and p.step_body["fusions"] > 0, name
+        assert p.step_body_budget == budget, name
+        assert p.step_body["fusions"] <= budget, (name, p.step_body)
+    # recorded (not budgeted) everywhere else too
+    k8 = audit_report.programs["masked/replicated/k8"]
+    assert k8.step_body is not None and k8.step_body["instructions"] > 0
+
+
+def test_step_body_budget_catches_unhoisted_masks():
+    """The seeded regression the budget exists for: re-materialising the
+    per-param masks inside the scan body AND dropping back to the
+    reference op chain (the pre-ISSUE-5 step body) must trip the
+    step-body-budget check on the masked k1 program."""
+    from heterofl_tpu.parallel import RoundEngine
+    from heterofl_tpu.staticcheck.audit import PSUM_BUDGET
+
+    setup = build_setup()
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    eng = RoundEngine(model, dict(cfg, fused_update=False,
+                                  _masks_in_body=True), mesh)
+    fix = (eng.fix_rates,) if eng.fix_rates is not None else ()
+    data = tuple(setup["data"]) + fix
+    n_dev = mesh.shape["clients"]
+    slots = setup["users"] + ((-setup["users"]) % n_dev)
+    sds = jax.ShapeDtypeStruct((slots,), np.int32)
+    n_leaves = len(jax.tree_util.tree_leaves(setup["params"]))
+    rep = audit_program(
+        "masked/replicated/k1", eng._build_train(),
+        (setup["params"], setup["key"], setup["lr"], sds, sds) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET}, mesh)
+    assert not rep.ok
+    hits = [f for f in rep.findings if f.rule == "step-body-budget"]
+    assert hits, rep.findings
+    assert rep.step_body["fusions"] > rep.step_body_budget
+
+
+def test_scan_body_kernel_count_parses_hlo():
+    """The HLO walker finds the while body and counts its fusions on a
+    minimal scanned program."""
+    from heterofl_tpu.staticcheck.jaxpr_walk import (scan_body_kernel_count,
+                                                     while_body_stats)
+
+    def f(c, _):
+        return jnp.sin(c) * 2.0 + jnp.cos(c), None
+
+    prog = jax.jit(lambda c: jax.lax.scan(f, c, None, length=64),
+                   donate_argnums=())
+    text = prog.lower(jnp.ones((128,), jnp.float32)).compile().as_text()
+    stats = while_body_stats(text)
+    assert stats, "no while body found in scanned program HLO"
+    body = scan_body_kernel_count(text)
+    assert body["body"] in stats and body["instructions"] > 0
+
+
+def test_lint_scope_covers_ops_and_models():
+    """ISSUE 5 satellite: the banned-call rules now apply to ops/ and
+    models/ (kernel/model code runs INSIDE the round programs)."""
+    src = """
+    import numpy as np
+    import time
+    def f(a):
+        t = time.time()
+        return np.asarray(a), float(a[0]), t
+    """
+    for scope in ("heterofl_tpu/ops/kernel.py", "heterofl_tpu/models/m.py"):
+        rules = {f.rule for f in _lint(src, scope)}
+        assert {"no-asarray", "no-float-coercion", "no-wallclock"} <= rules, \
+            (scope, rules)
+    # data/ stays out of scope for the kernel rules
+    assert _lint(src, "heterofl_tpu/data/pipeline.py") == []
