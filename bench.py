@@ -29,6 +29,24 @@ extra.strategies carries `<engine>+eval-fused` vs `<engine>+eval-host`
 rows, the host row paying the PR 2 clamp (dispatch windows shortened to
 min(K, E)) plus a host `eval` phase per window.
 
+BENCH_POPULATION=N (ISSUE 6): a population axis.  The federation grows to N
+synthetic users (up to 1e6) WITHOUT densifying per-user stacks: users window
+onto the shared synthetic sample pool via data.partition.span_population
+(O(N) metadata) and the engines stream each dispatch's sampled cohort
+through the ClientStore + stage_cohort pipeline, prefetching dispatch i+1's
+cohort while dispatch i computes (heavy-traffic sampling: BENCH_ACTIVE
+clients/round, default 10, round after round out of N users).
+extra.population records the store metadata bytes, peak host RSS
+(ru_maxrss) and the prefetched/sync staging counts -- with extra.phases'
+`stage` row this is the stage-time-and-RSS-stay-flat-in-population
+evidence.  The bench REFUSES to record a population run whose timed
+dispatches fell back to synchronous staging unless BENCH_ALLOW_SYNC_STAGE=1
+(the warmup dispatch is inherently synchronous and exempt);
+BENCH_STREAM_SYNC=1 forces the sync path (the refusal's test hook).
+Population runs pin eval off and the second-strategy record off by default,
+and are labelled degraded (a different workload than the 100-user
+flagship).
+
 MFU (ISSUE 5): extra.mfu reports the analytic FLOPs/round from
 fed.core.level_flop_table (expected over the uniform active-client draw)
 and, when BENCH_PEAK_FLOPS is set (the hardware peak in FLOP/s, e.g.
@@ -358,6 +376,15 @@ def main():
     # structure (VERDICT r4 item 5); the tiny one shrinks widths for a fast
     # insurance line, the real-width one shrinks only per-round data volume.
     users = int(os.environ.get("BENCH_USERS", "100"))
+    # BENCH_POPULATION=N (ISSUE 6): grow the federation to N streaming users
+    try:
+        population = int(float(os.environ.get("BENCH_POPULATION", "0") or 0))
+    except ValueError:
+        print(f"bench: ignoring malformed BENCH_POPULATION="
+              f"{os.environ['BENCH_POPULATION']!r}", file=sys.stderr)
+        population = 0
+    if population:
+        users = population
     n_train = int(os.environ.get("BENCH_SYNTH_N",
                                  "2000" if (fallback or realwidth) else "50000"))
     timed_rounds = int(os.environ.get("BENCH_ROUNDS",
@@ -387,6 +414,10 @@ def main():
         # tiny-width insurance line: must PRINT within ~2 min even cold
         cfg["resnet"] = {"hidden_size": [8, 16, 16, 16]}
         degraded = "cpu-fallback-tiny-width"
+    if population:
+        # a different federation (N users, fixed 10-client cohorts) -- never
+        # comparable to the 100-user 10 rps north star
+        degraded = f"population-{population}" + (f"+{degraded}" if degraded else "")
     if platform == "cpu":
         # XLA:CPU executes the client-vmapped grouped conv catastrophically
         # (measured 3.7x round slowdown); the numerically-identical im2col
@@ -395,11 +426,35 @@ def main():
 
     ds = fetch_dataset("CIFAR10", synthetic=True, seed=0,
                        synthetic_sizes={"train": n_train, "test": 1000})
-    rng = np.random.default_rng(0)
-    split, lsplit = split_dataset(ds, users, "iid", rng)
-    x, y, m = stack_client_shards(ds["train"].data, ds["train"].target, split["train"],
-                                  list(range(users)))
-    lm = label_split_masks(lsplit, users, 10)
+    store = None
+    pop_stats = {"prefetched": 0, "sync": 0}
+    pop_prefetch = os.environ.get("BENCH_STREAM_SYNC") != "1"
+    if population:
+        # streaming population (ISSUE 6): users window onto the shared
+        # synthetic pool -- O(population) metadata, no [U, ...] stacks, the
+        # flagship per-user shard volume (500 samples) regardless of N
+        from heterofl_tpu.data import span_population
+        from heterofl_tpu.parallel import ClientStore
+
+        cfg["client_store"] = "stream"
+        shard = min(int(os.environ.get("BENCH_POP_SHARD", "500")), n_train)
+        starts, sizes = span_population(n_train, population, shard)
+        store = ClientStore.from_spans(ds["train"].data, ds["train"].target,
+                                       starts, sizes, 10)
+        split = lsplit = None
+        x = np.zeros((0, shard), np.int8)  # population mode never stacks
+        lm = None
+        if os.environ.get("BENCH_EVAL_INTERVAL"):
+            print("bench: BENCH_EVAL_INTERVAL ignored in population mode "
+                  "(local eval is O(population); the axis measures staging)",
+                  file=sys.stderr)
+            os.environ["BENCH_EVAL_INTERVAL"] = "0"
+    else:
+        rng = np.random.default_rng(0)
+        split, lsplit = split_dataset(ds, users, "iid", rng)
+        x, y, m = stack_client_shards(ds["train"].data, ds["train"].target, split["train"],
+                                      list(range(users)))
+        lm = label_split_masks(lsplit, users, 10)
     cfg["classes_size"] = 10
     model = make_model(cfg)
     params = model.init(jax.random.key(0))
@@ -419,10 +474,20 @@ def main():
         return RoundEngine(model, c, mesh)
 
     engine = make_engine(strategy)
-    data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
-    hb(f"data staged + engine built (strategy {strategy})")
+    if population:
+        data = None
+        hb(f"population store built ({population} users, "
+           f"{store.metadata_nbytes} metadata bytes; strategy {strategy})")
+    else:
+        data = (jnp.asarray(x), jnp.asarray(y), jnp.asarray(m), jnp.asarray(lm))
+        hb(f"data staged + engine built (strategy {strategy})")
 
-    n_active = int(np.ceil(cfg["frac"] * users))
+    if population:
+        # heavy-traffic sampling: a bounded cohort per round, drawn from the
+        # whole population round after round (frac*N would melt any host)
+        n_active = int(os.environ.get("BENCH_ACTIVE", "10"))
+    else:
+        n_active = int(np.ceil(cfg["frac"] * users))
     # MFU account (ISSUE 5): analytic FLOPs per round from the ONE level
     # FLOP source of truth (fed.core.level_flop_table -- the same table the
     # staticcheck FLOP budget and scripts/grouped_flops.py consume),
@@ -431,8 +496,9 @@ def main():
     from heterofl_tpu.fed.core import level_flop_table
 
     flop_table = level_flop_table(cfg)
+    shard_n = store.shard_max if population else x.shape[1]
     local_steps = cfg["num_epochs"]["local"] * int(
-        np.ceil(x.shape[1] / cfg["batch_size"]["train"]))
+        np.ceil(shard_n / cfg["batch_size"]["train"]))
     flops_per_round = n_active * local_steps * float(
         np.mean([flop_table[float(r)] for r in rates_vec]))
     try:
@@ -492,6 +558,20 @@ def main():
     pipe = MetricsPipeline(fetch_every)
     base_key = jax.random.key(0)
 
+    # population mode (ISSUE 6): per-engine prefetched cohorts -- dispatch
+    # i+1's cohort stages while dispatch i's scanned program computes
+    _pop_cohorts = {}
+
+    def stage_pop(eng, strat, epoch0, k_disp, tmr):
+        from heterofl_tpu.fed.core import (superstep_rate_schedule,
+                                           superstep_user_schedule)
+
+        us = superstep_user_schedule(base_key, epoch0, k_disp, users, n_active)
+        if strat == "grouped":
+            rates = superstep_rate_schedule(base_key, epoch0, k_disp, cfg, us)
+            return eng.stage_cohort(store, us, rates, timer=tmr)
+        return eng.stage_cohort(store, us, timer=tmr)
+
     def dispatch(eng, strat, params, i, tmr, rng_, eval_mode=None, k_disp=None):
         """One timed dispatch: a single round (superstep==1) or a fused
         K-round superstep -- with BENCH_EVAL_INTERVAL, either eval-fused
@@ -499,6 +579,27 @@ def main():
         between windows under tmr.phase('eval'), PR 2 semantics).  Returns
         (params, PendingMetrics)."""
         k_disp = k_disp or superstep
+        if store is not None:
+            # streaming population: cohort staged ahead (prefetch depth 1);
+            # the warmup dispatch (i=0) is inherently synchronous and exempt
+            # from the sync-fallback refusal
+            epoch0 = 1 + i * k_disp
+            coh = _pop_cohorts.pop((id(eng), i), None)
+            if coh is None:
+                if i > 0:
+                    pop_stats["sync"] += 1
+                coh = stage_pop(eng, strat, epoch0, k_disp, tmr)
+            else:
+                pop_stats["prefetched"] += 1
+            params, pending = eng.train_superstep(
+                params, base_key, epoch0, k_disp, timer=tmr, cohort=coh)
+            if pop_prefetch and i < timed_rounds:
+                # the final timed dispatch has no successor; staging a
+                # cohort for it would bill a full host gather + device
+                # commit to the last round and never consume it
+                _pop_cohorts[(id(eng), i + 1)] = stage_pop(
+                    eng, strat, epoch0 + k_disp, k_disp, tmr)
+            return params, pending
         if k_disp > 1:
             epoch0 = 1 + i * k_disp
             mask = None
@@ -654,6 +755,17 @@ def main():
         # mid-run kill's salvaged line is not silently stale.
         ms = ctx["ms"]
         loss = float(np.asarray(ms["loss_sum"]).sum() / np.asarray(ms["n"]).sum())
+        pop_extra = {}
+        if population:
+            import resource
+
+            pop_extra["population"] = {
+                "users": population, "active_clients": n_active,
+                "shard_size": store.shard_max,
+                "store_metadata_bytes": store.metadata_nbytes,
+                "rss_max_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+                "prefetched_stages": pop_stats["prefetched"],
+                "sync_stages": pop_stats["sync"]}
         dt = steady_stats(ctx["rsec"], ctx["flags"])
         rps = 1.0 / dt
         summary = summarize(ctx["rsec"], ctx["flags"], ctx["compile_s"], timer,
@@ -683,6 +795,7 @@ def main():
                       **({"eval_interval": eval_iv} if eval_iv else {}),
                       **({"fetch_every": fetch_every,
                           "final_loss_round": ctx["ms_round"]} if fetch_every != 1 else {}),
+                      **pop_extra,
                       **({"strategies": strategies} if strategies else {}),
                       **({"step_ab": step_ab} if step_ab else {}),
                       **({"degraded": degraded} if degraded else {})},
@@ -695,6 +808,14 @@ def main():
     # rounds (extra.round_sec_avg/_best/_steady_avg carry the full picture).
     hb("compiling (warmup dispatch)")
 
+    def pop_sync_refused():
+        """The population-axis refusal (ISSUE 6) covers the per-round
+        salvage emits too: once a timed dispatch staged synchronously,
+        every line a supervisor might forward measures serialised staging,
+        not just the final summary."""
+        return (population and pop_stats["sync"]
+                and os.environ.get("BENCH_ALLOW_SYNC_STAGE") != "1")
+
     def on_round(r, pending, ctx):
         with timer.phase("fetch"):
             # tag with the last ROUND the dispatch covered, not the dispatch
@@ -703,15 +824,36 @@ def main():
             due = pipe.push(r * ctx.get("k_disp", superstep), pending)
         if due:
             ctx["ms_round"], ctx["ms"] = due[-1][0], last_loss(due[-1][1])
-        emit(ctx, r)
+        if not pop_sync_refused():
+            emit(ctx, r)
 
     primary_summary, ctx = measure(strategy, engine, params, timer,
                                    on_round=on_round,
                                    eval_mode="fused" if eval_iv else None)
     due = pipe.flush()
-    if due:  # deferred-fetch tail: re-emit with the final round's loss
+    if due and not pop_sync_refused():
+        # deferred-fetch tail: re-emit with the final round's loss
         ctx["ms_round"], ctx["ms"] = due[-1][0], last_loss(due[-1][1])
         emit(ctx, timed_rounds)
+
+    # population-mode staging contract (ISSUE 6): a record whose timed
+    # dispatches staged SYNCHRONOUSLY measures serialised staging, not the
+    # double-buffered pipeline -- refuse to record it as the population
+    # axis unless the operator explicitly overrides
+    if pop_sync_refused():
+        print(json.dumps({
+            "metric": "federated_rounds_per_sec_cifar10_resnet18_a1-e1_100c",
+            "value": 0.0, "unit": "rounds/sec", "vs_baseline": None,
+            "extra": {"error": f"{pop_stats['sync']} timed dispatch(es) fell "
+                               f"back to SYNCHRONOUS cohort staging; the "
+                               f"population axis measures the prefetched "
+                               f"pipeline (set BENCH_ALLOW_SYNC_STAGE=1 to "
+                               f"record anyway)",
+                      "population": {"users": population,
+                                     "prefetched_stages": pop_stats["prefetched"],
+                                     "sync_stages": pop_stats["sync"]}},
+        }), flush=True)
+        return
 
     def try_measure(strat, hb_prefix, eval_mode=None):
         """An extra record must never kill the primary one."""
@@ -734,7 +876,7 @@ def main():
     # With BENCH_EVAL_INTERVAL the strategies dict carries eval-fused vs
     # eval-host rows per engine (ISSUE 4 satellite) -- the A/B that shows
     # the last per-eval-window host round-trip disappearing.
-    both_default = "0" if (fallback or realwidth) else "1"
+    both_default = "0" if (fallback or realwidth or population) else "1"
     both = os.environ.get("BENCH_BOTH", both_default) == "1"
     alt = "grouped" if strategy != "grouped" else "masked"
     strategies = {}
@@ -770,7 +912,10 @@ def main():
     # K-round superstep scans, and the same body the staticcheck budget
     # gates; the record labels which program was lowered.  Failures never
     # kill the primary record.
-    if os.environ.get("BENCH_STEP_AB") == "1":
+    if os.environ.get("BENCH_STEP_AB") == "1" and population:
+        print("bench: BENCH_STEP_AB ignored in population mode (the step "
+              "A/B lowers the eager-data programs)", file=sys.stderr)
+    elif os.environ.get("BENCH_STEP_AB") == "1":
         try:
             from heterofl_tpu.staticcheck.jaxpr_walk import scan_body_kernel_count
 

@@ -135,7 +135,11 @@ DEFAULT_CFG: Dict[str, Any] = {
     # round t+1 dispatches while round t's sums transfer; eval boundaries
     # flush.  1 = synchronous fetch (reference parity).  K>1 logs train
     # metrics in K-round batches and a mid-batch checkpoint omits the not-
-    # yet-fetched rounds from logger history (a perf knob, not a semantics one).
+    # yet-fetched rounds from logger history (a perf knob, not a semantics
+    # one).  With superstep_rounds>1 the legal values are 1 and
+    # superstep_rounds: a larger batch would defer each superstep's eval
+    # metrics past its checkpoint and silently disable best-checkpoint
+    # tracking -- the driver fails loudly instead (ISSUE 6 satellite).
     "metrics_fetch_every": 1,
     # fused multi-round superstep: compile lax.scan over K federated rounds
     # into ONE jitted/donated program (parallel round_engine/grouped
@@ -156,6 +160,23 @@ DEFAULT_CFG: Dict[str, Any] = {
     # the jax key stream (fed.core.round_users) -- NOT the drivers' numpy
     # permutation stream used at superstep_rounds=1.
     "superstep_rounds": 1,
+    # streaming million-user client store (ISSUE 6, parallel/staging.py
+    # ClientStore + CohortStager): "eager" densifies the whole population
+    # into [num_users, ...] stacks staged up front (the reference layout --
+    # host/device memory scales with the population); "stream" keeps the
+    # population as an O(1)-per-user metadata index and materialises only
+    # each superstep's sampled cohort, committed via a double-buffered
+    # device_put pipeline -- memory scales with active_clients and
+    # superstep N+1's cohort stages while superstep N computes.  Streamed
+    # supersteps are bit-identical to eager ones at matched seeds.  Needs a
+    # mesh-native strategy; with superstep_rounds=1 the driver still runs
+    # the (k=1) superstep path so rounds stay one-dispatch.
+    "client_store": "eager",
+    # streaming prefetch: True overlaps superstep N+1's cohort staging with
+    # superstep N's compute (depth-1 double buffering); False forces
+    # SYNCHRONOUS staging -- the loud fallback for samplers whose next
+    # cohort depends on round-N outputs (the driver warns once).
+    "stream_prefetch": True,
     "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
     # Applied LAST by process_control: per-key overrides of any derived field

@@ -6,7 +6,7 @@ lives in :mod:`heterofl_tpu.ops.augment` so it fuses into the jitted step.
 """
 
 from .datasets import ArrayDataset, TokenDataset, fetch_dataset, DATASET_STATS  # noqa: F401
-from .partition import iid, non_iid, split_dataset  # noqa: F401
+from .partition import iid, non_iid, span_population, split_dataset  # noqa: F401
 from .pipeline import (  # noqa: F401
     process_dataset,
     batchify,

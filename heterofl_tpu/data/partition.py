@@ -8,6 +8,7 @@ behaviour matches (uniform permutations / shard draws).
 
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -81,6 +82,34 @@ def non_iid(dataset, num_users: int, rng: np.random.Generator,
             pick = int(rng.integers(len(pools[label_i])))
             data_split[i].extend(pools[label_i].pop(pick).tolist())
     return data_split, label_split
+
+
+def span_population(num_items: int, num_users: int, shard_size: int,
+                    stride: int = 9973) -> Tuple[np.ndarray, np.ndarray]:
+    """Synthetic million-user population layout: per-user contiguous
+    ``(start, size)`` windows onto a shared sample pool of ``num_items``
+    items -- O(num_users) metadata, no index lists, no densified stacks
+    (the ``ClientStore.from_spans`` input).
+
+    Users window onto the pool at a fixed odd ``stride`` so neighbouring
+    users see decorrelated (but overlapping) shards; every user gets the
+    same ``shard_size`` (static shapes: one program for the whole
+    population).  This is how a population larger than the physical dataset
+    is simulated -- the reference's disjoint iid split caps users at
+    ``num_items / shard_size``, which a million-user run cannot satisfy."""
+    if shard_size <= 0 or shard_size > num_items:
+        raise ValueError(f"shard_size {shard_size} must be in [1, {num_items}]")
+    hi = num_items - shard_size + 1
+    # a stride sharing a factor with hi collapses the walk onto
+    # hi/gcd distinct starts (gcd == hi: every user gets THE SAME shard)
+    # -- bump to the next stride coprime to hi so the window set always
+    # cycles through all hi offsets
+    stride = max(1, stride)
+    while math.gcd(stride, hi) != 1:
+        stride += 1
+    starts = (np.arange(num_users, dtype=np.int64) * stride) % hi
+    sizes = np.full(num_users, shard_size, np.int64)
+    return starts, sizes
 
 
 def split_dataset(dataset, num_users: int, data_split_mode: str, rng: np.random.Generator,

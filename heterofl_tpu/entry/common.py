@@ -16,6 +16,7 @@ import json
 import math
 import os
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -33,9 +34,11 @@ from ..data import (
     stack_client_shards,
     stack_client_token_rows,
 )
-from ..fed.core import round_rates, round_users, validate_width_geometry
+from ..fed.core import (round_rates, superstep_rate_schedule,
+                        superstep_user_schedule, validate_width_geometry)
 from ..models import make_model
-from ..parallel import MetricsPipeline, PendingMetrics, PhaseTimer, RoundEngine, make_mesh
+from ..parallel import (ClientStore, MetricsPipeline, PendingMetrics,
+                        PhaseTimer, RoundEngine, make_mesh)
 from ..parallel.evaluation import Evaluator
 from ..utils.compile_cache import enable_persistent_cache
 from ..utils import (
@@ -199,6 +202,22 @@ class FedExperiment:
         self.eval_interval = eval_iv
         if cfg.get("strategy", "masked") not in ("masked", "sliced", "grouped"):
             raise ValueError(f"Not valid strategy: {cfg.get('strategy')!r}")
+        # streaming client store (ISSUE 6): the population lives as an
+        # O(1)-per-user index (parallel/staging.ClientStore) and only each
+        # superstep's sampled cohort is materialised + prefetched
+        store_mode = cfg.get("client_store", "eager") or "eager"
+        if store_mode not in ("eager", "stream"):
+            raise ValueError(f"Not valid client_store: {store_mode!r}")
+        self.streaming = store_mode == "stream"
+        self.stream_prefetch = bool(cfg.get("stream_prefetch", True))
+        self.store: Optional[ClientStore] = None
+        self._next_cohort = None  # (epoch0, k, StagedCohort) prefetched
+        self._stream_sync_warned = False
+        if self.streaming and cfg.get("strategy") == "sliced":
+            raise ValueError(
+                "client_store='stream' needs a mesh-native strategy "
+                "('masked' or 'grouped'): the cohort pipeline stages "
+                "through the engines' superstep programs")
         # fused multi-round superstep (ISSUE 2) with the sBN+eval phase
         # folded into the scan (ISSUE 4): K rounds per compiled program,
         # eval windows no longer clamp K.  Most knob combinations are now
@@ -216,7 +235,8 @@ class FedExperiment:
                     f"metrics_fetch_every={fetch_every} conflicts with "
                     f"superstep_rounds={K}: a superstep fetches its metrics "
                     f"exactly once per K rounds (use 1 for synchronous fetch "
-                    f"or a multiple of {K} to defer whole supersteps)")
+                    f"or exactly {K}; larger multiples would defer metrics "
+                    f"past the superstep's checkpoint)")
             if isinstance(self.scheduler, PlateauScheduler):
                 # ISSUE 4 relaxation: Plateau IS expressible now -- the LR is
                 # constant within a superstep (staged scalar, not the traced
@@ -237,10 +257,18 @@ class FedExperiment:
                         f"metrics before the next superstep dispatches; "
                         f"metrics_fetch_every={fetch_every} would defer them "
                         f"(use 1 or {K})")
+            if fetch_every > K:
+                # ISSUE 6 satellite: deferring fetch past the superstep
+                # boundary makes pivot_fresh (run()) never true -- the
+                # best-checkpoint copy silently stops updating.  Every
+                # comparable knob conflict fails loudly; so does this one.
+                raise ValueError(
+                    f"metrics_fetch_every={fetch_every} exceeds "
+                    f"superstep_rounds={K}: each superstep's eval metrics "
+                    f"would be deferred past its checkpoint, silently "
+                    f"disabling best-checkpoint tracking (pivot never "
+                    f"fresh); use 1 or {K}")
             if eval_iv % K and K % eval_iv:
-                import math
-                import warnings
-
                 # legal (the mask is data for the driver, structure for the
                 # compiler) but worth a loud note: each distinct mask pattern
                 # compiles its own K-round program (~40s at flagship scale)
@@ -256,9 +284,16 @@ class FedExperiment:
             self.metrics_pipe = MetricsPipeline(max(1, fetch_every // K))
         else:
             self.metrics_pipe = MetricsPipeline(fetch_every)
+            if self.streaming and fetch_every > 1:
+                # streaming routes superstep_rounds=1 through the (k=1)
+                # superstep path, whose pivot needs a synchronous fetch --
+                # same silent best-checkpoint disable as fetch > K above
+                raise ValueError(
+                    f"metrics_fetch_every={fetch_every} with "
+                    f"client_store='stream' at superstep_rounds=1 would "
+                    f"defer each round's eval metrics past its checkpoint "
+                    f"(best-checkpoint pivot never fresh); use 1")
             if self.metrics_pipe.fetch_every > eval_iv:
-                import warnings
-
                 # evaluate() drains the pipeline, so batches never grow past
                 # the eval interval -- say so instead of silently
                 # under-delivering
@@ -294,6 +329,26 @@ class FedExperiment:
     def stage(self, data_split, label_split):
         cfg = self.cfg
         U = cfg["num_users"]
+        if self.streaming:
+            # ISSUE 6: no [U, ...] densification -- the population is an
+            # O(1)-per-user index over the raw arrays, and train cohorts
+            # materialise per superstep (stage_cohort + prefetch).  Eval
+            # operands stage LAZILY on the first eval: local (per-user)
+            # eval is the one remaining O(U) surface, so runs that never
+            # evaluate (population benches) never pay it.
+            tr = self.dataset["train"]
+            if self.kind == "vision":
+                self.store = ClientStore.from_split(
+                    tr.data, tr.target, data_split["train"], label_split,
+                    cfg["classes_size"])
+            else:
+                self.store = ClientStore.from_split(
+                    tr.token, None, data_split["train"], label_split,
+                    cfg["num_tokens"], kind="lm")
+            self.train_data = None
+            self._eval_split = (data_split["test"], label_split)
+            self._eval_staged = False
+            return
         if self.kind == "vision":
             tr = self.dataset["train"]
             x, y, m = stack_client_shards(tr.data, tr.target, data_split["train"], list(range(U)))
@@ -313,6 +368,29 @@ class FedExperiment:
             te = self.dataset["test"]
             xs, ws = stack_windows(bptt_windows(te.token, cfg["bptt"]), cfg["bptt"])
             self.global_eval = (xs, ws)
+
+    def _ensure_eval_staged(self):
+        """Streaming mode's lazy eval staging (see :meth:`stage`)."""
+        if not self.streaming or self._eval_staged:
+            return
+        cfg = self.cfg
+        U = cfg["num_users"]
+        test_split, label_split = self._eval_split
+        if self.kind == "vision":
+            if U > 100_000:
+                warnings.warn(
+                    f"local eval stages every user's test shard (O(U) at "
+                    f"num_users={U}); cap eval_interval past num_epochs or "
+                    f"stick to population benches if this OOMs")
+            lm = label_split_masks(label_split, U, cfg["classes_size"])
+            self.sbn_batches, self.local_eval, self.global_eval = \
+                stage_eval_operands(cfg, self.dataset["train"],
+                                    self.dataset["test"], test_split, lm)
+        else:
+            te = self.dataset["test"]
+            xs, ws = stack_windows(bptt_windows(te.token, cfg["bptt"]), cfg["bptt"])
+            self.global_eval = (xs, ws)
+        self._eval_staged = True
 
     # -- one round -----------------------------------------------------
 
@@ -378,19 +456,60 @@ class FedExperiment:
 
     def _superstep_schedule(self, epoch0: int, k: int) -> np.ndarray:
         """Host-side [k, A] active-user draw from the superstep sampling
-        stream (fed.core.round_users): what the masked engine samples in-jit,
-        evaluated on the host where slot packing needs the ids (sharded
-        placement, grouped level grouping)."""
-        return np.stack([
-            np.asarray(round_users(jax.random.fold_in(self.host_key, epoch0 + r),
-                                   self.cfg["num_users"], self.num_active))
-            for r in range(k)])
+        stream (fed.core.superstep_user_schedule): what the masked engine
+        samples in-jit, evaluated on the host where slot packing needs the
+        ids (sharded placement, grouped level grouping, cohort staging)."""
+        return superstep_user_schedule(self.host_key, epoch0, k,
+                                       self.cfg["num_users"], self.num_active)
+
+    # -- streaming cohort pipeline (ISSUE 6) ---------------------------
+
+    def _stage_cohort(self, epoch0: int, k: int):
+        """Materialise + commit the cohort for rounds ``epoch0..epoch0+k-1``
+        through the engine's store-backed staging."""
+        users = self._superstep_schedule(epoch0, k)
+        if self.cfg.get("strategy") == "grouped":
+            rates = superstep_rate_schedule(self.host_key, epoch0, k,
+                                            self.cfg, users)
+            return self.alt_engine.stage_cohort(self.store, users, rates,
+                                                timer=self.phase_timer)
+        return self.engine.stage_cohort(self.store, users,
+                                        timer=self.phase_timer)
+
+    def _take_cohort(self, epoch0: int, k: int):
+        """The prefetched cohort for this superstep, or a synchronous stage
+        (first superstep of a run; ``stream_prefetch`` off -- warned once:
+        a sampler that depends on round-N outputs cannot prefetch, and the
+        staging then serialises with compute)."""
+        nxt, self._next_cohort = self._next_cohort, None
+        if nxt is not None and nxt[0] == epoch0 and nxt[1] == k:
+            return nxt[2]
+        if not self.stream_prefetch and not self._stream_sync_warned:
+            self._stream_sync_warned = True
+            warnings.warn(
+                "client_store='stream' is staging SYNCHRONOUSLY "
+                "(stream_prefetch=False): cohort materialisation serialises "
+                "with the round compute instead of overlapping it")
+        return self._stage_cohort(epoch0, k)
+
+    def _prefetch_cohort(self, epoch0: int):
+        """Stage the NEXT superstep's cohort right after this superstep
+        dispatched: the device_put pipeline overlaps with the in-flight
+        scanned program (depth-1 double buffering)."""
+        if not self.stream_prefetch:
+            return
+        n_rounds = self.cfg["num_epochs"]["global"]
+        if epoch0 > n_rounds:
+            return
+        k = min(self.superstep_rounds, n_rounds - epoch0 + 1)
+        self._next_cohort = (epoch0, k, self._stage_cohort(epoch0, k))
 
     def _fused_eval(self):
         """The experiment's :class:`~..parallel.evaluation.FusedEval`: eval
         operands committed once (shared with the host-path memos), built
         lazily on the first eval-bearing superstep."""
         if self._fused is None:
+            self._ensure_eval_staged()
             if self.kind == "vision":
                 self._fused = self.evaluator.fused(
                     sbn_batches=self.sbn_batches, local_eval=self.local_eval,
@@ -423,12 +542,23 @@ class FedExperiment:
         lr_const = self.scheduler(epoch0) if plateau else None
         t0 = time.time()
         phases0 = self.phase_timer.snapshot()
-        if cfg.get("strategy") == "grouped":
+        if self.streaming:
+            # the cohort was (normally) prefetched while the PREVIOUS
+            # superstep computed; dispatch it, then immediately stage the
+            # next one so its device_put pipeline overlaps with this
+            # superstep's in-flight scan
+            cohort = self._take_cohort(epoch0, k)
+            eng = self.alt_engine if cfg.get("strategy") == "grouped" \
+                else self.engine
+            params, pending = eng.train_superstep(
+                params, self.host_key, epoch0, k, timer=self.phase_timer,
+                eval_mask=mask if fused else None, fused_eval=fused,
+                lr=lr_const, cohort=cohort)
+            self._prefetch_cohort(epoch0 + k)
+        elif cfg.get("strategy") == "grouped":
             users = self._superstep_schedule(epoch0, k)
-            rates = np.stack([
-                np.asarray(round_rates(jax.random.fold_in(self.host_key, epoch0 + r),
-                                       cfg, jnp.asarray(users[r])))
-                for r in range(k)])
+            rates = superstep_rate_schedule(self.host_key, epoch0, k, cfg,
+                                            users)
             params, pending = self.alt_engine.train_superstep(
                 params, self.host_key, epoch0, k, users, rates,
                 self.train_data, timer=self.phase_timer,
@@ -485,6 +615,12 @@ class FedExperiment:
     def _log_fused_eval(self, logger: Logger, epoch: int, ev: Dict[str, Any]):
         """Mirror :meth:`evaluate`'s logging for one fused eval result."""
         cfg = self.cfg
+        # each fused eval's test means stand alone (ISSUE 6 satellite): the
+        # K=1 host loop resets the logger every round, so without this a
+        # superstep's later evals BLEND with its earlier ones and the
+        # best-checkpoint pivot / Plateau feed compare a blended mean
+        # instead of the boundary round's own eval
+        logger.reset_tag("test")
         if self.kind == "vision" and ev["local"]:
             local = ev["local"]
             named_local = summarize_sums(local, cfg["model_name"])
@@ -535,6 +671,7 @@ class FedExperiment:
         :meth:`_fused_eval`; the staticcheck lint keeps host eval dispatch
         out of the steady-state superstep stride)."""
         self._drain_metrics(logger)  # eval boundary: fetch any deferred rounds
+        self._ensure_eval_staged()
         cfg = self.cfg
         bn = {}
         if self.kind == "vision":
@@ -613,7 +750,10 @@ class FedExperiment:
             # superstep boundaries; evals inside a superstep are logged (and
             # feed Plateau) when its metrics are fetched.
             k_eff = 1
-            if self.superstep_rounds > 1:
+            if self.superstep_rounds > 1 or self.streaming:
+                # streaming always takes the superstep path (k_eff=1 at
+                # superstep_rounds=1): cohorts ride the scanned program's
+                # xs, so there is exactly one store-backed dispatch shape
                 k_eff = min(self.superstep_rounds, n_rounds - epoch + 1)
                 # a clamped end-of-run tail still goes through the superstep
                 # path (smaller k) so ONE sampling stream covers the run

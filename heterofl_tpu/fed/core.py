@@ -111,6 +111,32 @@ def round_users(round_key: jax.Array, num_users: int, num_active: int) -> jnp.nd
     return perm[:num_active].astype(jnp.int32)
 
 
+def superstep_user_schedule(host_key: jax.Array, epoch0: int, k: int,
+                            num_users: int, num_active: int) -> np.ndarray:
+    """Host-side ``[k, A]`` active-user draw from THE superstep sampling
+    stream (:func:`round_users` at per-round keys ``fold_in(host_key,
+    epoch0 + r)``): the one host twin of the masked engine's in-jit draw.
+    Shared by the fed drivers, ``bench.py``, the streaming cohort staging
+    and the equivalence tests -- a private copy of this loop is how the
+    superstep stream silently forks."""
+    return np.stack([
+        np.asarray(round_users(jax.random.fold_in(host_key, epoch0 + r),
+                               num_users, num_active))
+        for r in range(k)])
+
+
+def superstep_rate_schedule(host_key: jax.Array, epoch0: int, k: int,
+                            cfg: Dict[str, Any], user_schedule) -> np.ndarray:
+    """Host-side ``[k, A]`` absolute-rate draw matching
+    :func:`superstep_user_schedule`'s rounds (:func:`round_rates` at the
+    same per-round keys) -- what the grouped engine's slot grouping and the
+    masked engine's in-jit draw both consume."""
+    return np.stack([
+        np.asarray(round_rates(jax.random.fold_in(host_key, epoch0 + r), cfg,
+                               jnp.asarray(user_schedule[r])))
+        for r in range(k)])
+
+
 def snap_to_levels(rates, levels, rtol: float = 1e-5, atol: float = 1e-8) -> np.ndarray:
     """Snap sampled absolute model rates onto an engine's level table.
 

@@ -1,5 +1,6 @@
 from .grouped import GroupedRoundEngine  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from .round_engine import RoundEngine, shard_client_data  # noqa: F401
-from .staging import (MetricsPipeline, PendingMetrics, PhaseTimer,  # noqa: F401
-                      PlacementCache, SlotPacker)
+from .staging import (ClientStore, CohortStager, MetricsPipeline,  # noqa: F401
+                      PendingMetrics, PhaseTimer, PlacementCache, SlotPacker,
+                      StagedCohort)

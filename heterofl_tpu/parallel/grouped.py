@@ -55,7 +55,7 @@ aggregation algebra, so the choice is per-experiment (``cfg['strategy']``).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -69,7 +69,8 @@ from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
 from ..utils.optim import make_traced_lr_fn
 from .round_engine import RoundEngine, _bucket_pow2, _ceil_div, _shard_map
-from .staging import PendingMetrics, PhaseTimer, PlacementCache, SlotPacker
+from .staging import (ClientStore, CohortStager, PendingMetrics, PhaseTimer,
+                      PlacementCache, SlotPacker, StagedCohort)
 
 
 class GroupedRoundEngine:
@@ -117,6 +118,8 @@ class GroupedRoundEngine:
         # dispatch device-resident buffers with zero implicit resharding
         self._staging = PlacementCache(mesh)
         self._packer = SlotPacker()
+        # streaming cohort pipeline (ISSUE 6): built on first stage_cohort
+        self._cohort_stager = None
         if self.level_placement == "slices":
             if jax.process_count() > 1:
                 # slice boundaries are not host-aligned yet: a level whose
@@ -178,14 +181,19 @@ class GroupedRoundEngine:
     # -- per-level program ---------------------------------------------
 
     def _level_core(self, rate: float, params, key, lr, uarr, data,
-                    n_data: int = 1, data_axis=None):
+                    n_data: int = 1, data_axis=None, local_data: bool = False):
         """One level's per-device in-jit core (inside ``shard_map``): dense
         local training of this device's ``uarr`` slots at ``rate`` and the
         level's counted sums in SLICED shape.  NO collectives -- the callers
         reduce: the per-level program psums sliced then embeds once, the
         fused superstep embeds per device and joins a single global psum
         (zero-pad embedding commutes with the sum exactly, so both
-        associations add the same addends elementwise)."""
+        associations add the same addends elementwise).
+
+        ``local_data=True`` (ISSUE 6 streaming): ``data`` is already in
+        slot order -- row j IS slot j's shard -- so no gather; ``uarr``
+        still carries the GLOBAL user ids for the PRNG streams and slot
+        validity."""
         gm = self.global_model
         model_l, eng_l = self.levels[rate]
         wr = rate / self.global_rate  # static for this core
@@ -202,16 +210,17 @@ class GroupedRoundEngine:
             valid = valid * alive
         sub = extract_sliced_jnp(params, gm.specs, gm.groups, wr)
         slot_keys = jax.vmap(lambda u: jax.random.fold_in(key, 13 + u))(ugid)
-        lm = lm_all[ugid]
+        lm = lm_all if local_data else lm_all[ugid]
         if self.is_lm:
-            rows = data[0][ugid]
+            rows = data[0] if local_data else data[0][ugid]
             trained, ms = jax.vmap(
                 lambda r_, l_, k_: eng_l._local_train_lm(
                     sub, 1.0, r_, l_, k_, lr, scaler_rate=wr,
                     data_axis=data_axis, n_data=n_data)
             )(rows, lm, slot_keys)
         else:
-            xs, ys, sms = data[0][ugid], data[1][ugid], data[2][ugid]
+            xs, ys, sms = (data[0], data[1], data[2]) if local_data \
+                else (data[0][ugid], data[1][ugid], data[2][ugid])
             trained, ms = jax.vmap(
                 lambda x_, y_, m_, l_, k_: eng_l._local_train_vision(
                     sub, 1.0, x_, y_, m_, l_, k_, lr, scaler_rate=wr,
@@ -418,7 +427,8 @@ class GroupedRoundEngine:
         return "span", None
 
     def _superstep_prog(self, k: int, per_dev: int, mode: str, eval_mask=None,
-                        fused_eval=None, lr_arg: bool = False):
+                        fused_eval=None, lr_arg: bool = False,
+                        streaming: bool = False):
         """ONE jitted+donated ``shard_map`` program for ``k`` grouped rounds:
         the five per-level programs AND the combine fused into a single XLA
         program, wrapped in a ``lax.scan`` over the rounds (ISSUE 2).
@@ -445,10 +455,17 @@ class GroupedRoundEngine:
         collectives stay uniform across devices); the per-training-round
         single-psum invariant is untouched and the eval phase's reductions
         are audited separately.  ``lr_arg``: LR as a staged scalar instead
-        of the traced schedule (ReduceLROnPlateau superstep mode)."""
+        of the traced schedule (ReduceLROnPlateau superstep mode).
+
+        ``streaming=True`` (ISSUE 6): the replicated population stacks are
+        replaced by the sampled cohort's shards riding the scan xs in the
+        SAME slot layout as the schedule (span: ``[k, L, slots, ...]``,
+        slices: ``[k, slots, ...]``, slot axis sharded over ``clients``);
+        each level's core then indexes identity -- program memory is
+        O(k x levels x slots), independent of the population."""
         from .round_engine import eval_fused_scan, superstep_eval_groups
 
-        key_ = (k, per_dev, mode, eval_mask, lr_arg)
+        key_ = (k, per_dev, mode, eval_mask, lr_arg, streaming)
         if key_ in self._superstep_progs:
             return self._superstep_progs[key_]
         gm = self.global_model
@@ -481,11 +498,19 @@ class GroupedRoundEngine:
                 lr_const = rest[0]
                 idx = 1
             sched = rest[idx]
-            data = rest[idx + 1:idx + 1 + n_data_args]
-            eval_ops = rest[idx + 1 + n_data_args:]
+            if streaming:
+                sdata = rest[idx + 1:idx + 1 + n_data_args]
+                eval_ops = rest[idx + 1 + n_data_args:]
+                data = None
+            else:
+                data = rest[idx + 1:idx + 1 + n_data_args]
+                eval_ops = rest[idx + 1 + n_data_args:]
 
             def step(p, xs):
-                t, srow = xs
+                if streaming:
+                    t, srow, *d = xs
+                else:
+                    t, srow = xs
                 key = jax.random.fold_in(base_key, t)
                 lr = lr_const if lr_arg else lr_fn(t)
                 if mode == "span":
@@ -493,8 +518,10 @@ class GroupedRoundEngine:
                     tot_s = tot_c = None
                     ms_levels = []
                     for li, rate in enumerate(level_rates):
+                        d_li = tuple(x[li] for x in d) if streaming else data
                         s_l, c_l, ms_l = self._level_core(
-                            rate, p, key, lr, srow[li], data, n_data, data_axis)
+                            rate, p, key, lr, srow[li], d_li, n_data,
+                            data_axis, local_data=streaming)
                         s_l, c_l = embed(s_l, rate), embed(c_l, rate)
                         tot_s = s_l if tot_s is None else \
                             {n: tot_s[n] + s_l[n] for n in tot_s}
@@ -510,8 +537,10 @@ class GroupedRoundEngine:
 
                     def mk(rate):
                         def f(p_, key_l, lr_l, u_):
-                            s, c, m = self._level_core(rate, p_, key_l, lr_l,
-                                                       u_, data, 1, None)
+                            s, c, m = self._level_core(
+                                rate, p_, key_l, lr_l, u_,
+                                tuple(d) if streaming else data, 1, None,
+                                local_data=streaming)
                             return embed(s, rate), embed(c, rate), m
                         return f
 
@@ -525,7 +554,7 @@ class GroupedRoundEngine:
                 return new_p, ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
-            xs = (epochs, sched)
+            xs = (epochs, sched) + (tuple(sdata) if streaming else ())
             if groups is None:
                 new_params, ms = jax.lax.scan(step, params, xs)
                 return new_params, ms
@@ -535,10 +564,14 @@ class GroupedRoundEngine:
             return eval_fused_scan(step, params, xs, epochs, groups,
                                    fused_eval, eval_ops)
 
-        data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
         lr_specs = (P(),) if lr_arg else ()
         eval_specs = tuple(fused_eval.specs) if groups else ()
         sched_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
+        if streaming:
+            # cohort stacks ride the xs in the schedule's own slot layout
+            data_specs = (sched_spec,) * n_data_args
+        else:
+            data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
         ms_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
         out_specs = (P(), ms_spec)
         if groups is not None:
@@ -553,11 +586,124 @@ class GroupedRoundEngine:
         self._superstep_progs[key_] = prog
         return prog
 
+    def _cohort_layout(self, user_schedule: np.ndarray,
+                       rate_schedule: np.ndarray):
+        """Shared slot-layout math of the eager schedule packing and the
+        streaming cohort staging: snap rates, group positions per level,
+        and bucket the per-device slot count.  Returns ``(sched_shape,
+        per_dev, mode, positions, level_rates)`` -- the schedule buffer of
+        ``sched_shape`` (span: ``[k, L, n_dev*per_dev]``, slices:
+        ``[k, n_dev*per_dev]`` with each level at its slice rows) is
+        allocated by the caller and written by ``_fill_schedule``."""
+        k, a = user_schedule.shape
+        n_dev = self.mesh.shape["clients"]
+        snapped = snap_to_levels(rate_schedule.reshape(-1), self.levels)
+        rate_schedule = snapped.reshape(k, a)
+        level_rates = sorted(self.levels, reverse=True)
+        mode, _ = self._fused_layout()
+        positions = [[np.flatnonzero(rate_schedule[r] == lr_)
+                      for lr_ in level_rates] for r in range(k)]
+        if mode == "slices":
+            rows = {r: self._slices[r][1] - self._slices[r][0]
+                    for r in level_rates}
+            need = max(_ceil_div(len(pos), rows[lr_]) if len(pos) else 1
+                       for per_round in positions
+                       for lr_, pos in zip(level_rates, per_round))
+            per_dev = _bucket_pow2(need)
+            shape = (k, n_dev * per_dev)
+        else:
+            need = max(_ceil_div(len(pos), n_dev) if len(pos) else 1
+                       for per_round in positions for pos in per_round)
+            per_dev = _bucket_pow2(need)
+            shape = (k, len(level_rates), n_dev * per_dev)
+        return shape, per_dev, mode, positions, level_rates
+
+    @staticmethod
+    def _fill_schedule(sched: np.ndarray, user_schedule: np.ndarray,
+                       positions, level_rates, mode, per_dev, slices):
+        """Write the packed slot ids into a (pre-filled -1) schedule buffer
+        -- one code path for the eager and streaming stagings."""
+        k = user_schedule.shape[0]
+        if mode == "slices":
+            for r in range(k):
+                for lr_, pos in zip(level_rates, positions[r]):
+                    lo = slices[lr_][0]
+                    sched[r, lo * per_dev: lo * per_dev + len(pos)] = \
+                        user_schedule[r][pos]
+        else:
+            for r in range(k):
+                for li, pos in enumerate(positions[r]):
+                    sched[r, li, : len(pos)] = user_schedule[r][pos]
+
+    def stage_cohort(self, store: ClientStore, user_schedule,
+                     rate_schedule, timer: PhaseTimer = None) -> StagedCohort:
+        """Materialise + commit ONE superstep's cohort from a
+        :class:`~.staging.ClientStore` (ISSUE 6): the cohort's shards pack
+        into the stager's ring buffers in the SAME per-level slot layout as
+        the schedule (level grouping is slot bookkeeping, done here once
+        per superstep) and commit via explicit ``device_put`` + private
+        copy.  O(k x levels x slots x shard) memory, population-free.
+        Call for superstep N+1 right after dispatching superstep N."""
+        timer = timer if timer is not None else PhaseTimer()
+        with timer.phase("stage"):
+            # staticcheck: allow(no-asarray): host schedule normalization;
+            # the cohort reaches the mesh via the stager's explicit puts only
+            user_schedule = np.asarray(user_schedule, np.int32)
+            rate_schedule = np.asarray(rate_schedule)  # staticcheck: allow(no-asarray): host schedule normalization
+            if user_schedule.shape != rate_schedule.shape \
+                    or user_schedule.ndim != 2:
+                raise ValueError(
+                    f"user/rate schedules must both be [k, A], got "
+                    f"{user_schedule.shape} / {rate_schedule.shape}")
+            k, a = user_schedule.shape
+            shape, per_dev, mode, positions, level_rates = \
+                self._cohort_layout(user_schedule, rate_schedule)
+            if self._cohort_stager is None:
+                self._cohort_stager = CohortStager(self.mesh)
+            st = self._cohort_stager
+            n = store.shard_max
+            if self.is_lm:
+                dshapes = [shape + store.row_shape,
+                           shape + (store.classes_size,)]
+                dtypes = [store.data.dtype, np.float32]
+            else:
+                dshapes = [shape + (n,) + store.data.shape[1:],
+                           shape + (n,), shape + (n,),
+                           shape + (store.classes_size,)]
+                dtypes = [store.data.dtype, store.target.dtype, np.float32,
+                          np.float32]
+            layouts = [(shape, np.int32, -1)] + \
+                [(s, d, None) for s, d in zip(dshapes, dtypes)]
+            key = ("grouped", mode, shape)
+            slot_i, bufs = st.buffers(key, layouts)
+            sched = bufs[0]
+            self._fill_schedule(sched, user_schedule, positions, level_rates,
+                                mode, per_dev, self._slices)
+            flat = sched.reshape(-1)
+            if self.is_lm:
+                store.fill_lm(flat, bufs[1].reshape((-1,) + store.row_shape))
+                store.fill_labels(flat, bufs[2].reshape(-1, store.classes_size))
+            else:
+                store.fill_vision(flat,
+                                  bufs[1].reshape((-1, n) + store.data.shape[1:]),
+                                  bufs[2].reshape(-1, n),
+                                  bufs[3].reshape(-1, n))
+                store.fill_labels(flat, bufs[4].reshape(-1, store.classes_size))
+            spec = P(None, None, "clients") if mode == "span" \
+                else P(None, "clients")
+            dev = st.commit(key, slot_i, bufs, (spec,) * len(bufs))
+        return StagedCohort(engine="grouped", k=k, a=a, per_dev=per_dev,
+                            sched=dev[0], data=tuple(dev[1:]), mode=mode,
+                            positions=positions)
+
     def train_superstep(self, global_params: Dict[str, Any], base_key,
-                        epoch0: int, k: int, user_schedule: np.ndarray,
-                        rate_schedule: np.ndarray, data: Tuple,
+                        epoch0: int, k: int,
+                        user_schedule: Optional[np.ndarray] = None,
+                        rate_schedule: Optional[np.ndarray] = None,
+                        data: Optional[Tuple] = None,
                         timer: PhaseTimer = None, eval_mask=None,
-                        fused_eval=None, lr=None):
+                        fused_eval=None, lr=None,
+                        cohort: Optional[StagedCohort] = None):
         """Run ``k`` grouped rounds as ONE compiled program.
 
         ``user_schedule``: int32 ``[k, A]`` active user ids per round (the
@@ -576,7 +722,13 @@ class GroupedRoundEngine:
         into the scan on the masked rounds; the fetch then yields
         ``{"train": [...], "eval": [...]}`` (see
         :meth:`~.round_engine.RoundEngine.train_superstep`).  ``lr``: stage
-        a constant LR scalar (ReduceLROnPlateau superstep mode)."""
+        a constant LR scalar (ReduceLROnPlateau superstep mode).
+
+        ``cohort`` (ISSUE 6): a :class:`~.staging.StagedCohort` from
+        :meth:`stage_cohort` replaces ``user_schedule``/``rate_schedule``/
+        ``data`` -- the level-grouped cohort rides the scan xs and the
+        program never sees the population stacks; results are bit-identical
+        to the eager path at matched schedules."""
         from .round_engine import normalize_eval_mask
 
         eval_mask = normalize_eval_mask(eval_mask, k, fused_eval)
@@ -584,59 +736,60 @@ class GroupedRoundEngine:
         if not lr_arg and self._lr_fn is None:
             self._lr_fn = make_traced_lr_fn(self.cfg)
         timer = timer if timer is not None else PhaseTimer()
-        with timer.phase("stage"):
-            n_dev = self.mesh.shape["clients"]
-            # staticcheck: allow(no-asarray): host schedule normalization;
-            # the packed slots reach the mesh via explicit staging.put only
-            user_schedule = np.asarray(user_schedule, np.int32)
-            rate_schedule = np.asarray(rate_schedule)  # staticcheck: allow(no-asarray): host schedule normalization
-            if user_schedule.shape != rate_schedule.shape \
-                    or user_schedule.ndim != 2 or user_schedule.shape[0] != k:
+        if cohort is not None:
+            if cohort.engine != "grouped" or cohort.k != k:
                 raise ValueError(
-                    f"user/rate schedules must both be [k={k}, A], got "
-                    f"{user_schedule.shape} / {rate_schedule.shape}")
-            a = user_schedule.shape[1]
-            snapped = snap_to_levels(rate_schedule.reshape(-1), self.levels)
-            rate_schedule = snapped.reshape(k, a)
-            level_rates = sorted(self.levels, reverse=True)
-            mode, _ = self._fused_layout()
-            # per-round per-level positions into the A-vector (metric
-            # reassembly + slot packing share this)
-            positions = [[np.flatnonzero(rate_schedule[r] == lr_)
-                          for lr_ in level_rates] for r in range(k)]
-            if mode == "slices":
-                rows = {r: self._slices[r][1] - self._slices[r][0]
-                        for r in level_rates}
-                need = max(_ceil_div(len(pos), rows[lr_]) if len(pos) else 1
-                           for per_round in positions
-                           for lr_, pos in zip(level_rates, per_round))
-                per_dev = _bucket_pow2(need)
-                sched = self._packer.buffer(("gss_sl", k, n_dev, per_dev),
-                                            (k, n_dev * per_dev))
-                for r in range(k):
-                    for lr_, pos in zip(level_rates, positions[r]):
-                        lo = self._slices[lr_][0]
-                        sched[r, lo * per_dev: lo * per_dev + len(pos)] = \
-                            user_schedule[r][pos]
-            else:
-                need = max(_ceil_div(len(pos), n_dev) if len(pos) else 1
-                           for per_round in positions for pos in per_round)
-                per_dev = _bucket_pow2(need)
-                sched = self._packer.buffer(("gss_sp", k, len(level_rates), per_dev),
-                                            (k, len(level_rates), n_dev * per_dev))
-                for r in range(k):
-                    for li, pos in enumerate(positions[r]):
-                        sched[r, li, : len(pos)] = user_schedule[r][pos]
-            args = self._staging.replicated("train_data", data)
-            spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
-            sched_dev = self._staging.put(sched, spec=spec)
-            lr_args = (self._staging.scalar(lr),) if lr_arg else ()
-            eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
-            epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
-            # commit the params carry (see train_round), layout pinned
-            global_params = self._staging.commit(self._pin(global_params))
-            prog = self._superstep_prog(k, per_dev, mode, eval_mask=eval_mask,
-                                        fused_eval=fused_eval, lr_arg=lr_arg)
+                    f"cohort mismatch: staged for engine={cohort.engine!r} "
+                    f"k={cohort.k}, dispatching grouped k={k}")
+            with timer.phase("stage"):
+                a, per_dev, mode = cohort.a, cohort.per_dev, cohort.mode
+                positions = cohort.positions
+                level_rates = sorted(self.levels, reverse=True)
+                sched_dev, args = cohort.sched, tuple(cohort.data)
+                lr_args = (self._staging.scalar(lr),) if lr_arg else ()
+                eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
+                epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
+                global_params = self._staging.commit(self._pin(global_params))
+                prog = self._superstep_prog(k, per_dev, mode,
+                                            eval_mask=eval_mask,
+                                            fused_eval=fused_eval,
+                                            lr_arg=lr_arg, streaming=True)
+        else:
+            if user_schedule is None or rate_schedule is None or data is None:
+                raise ValueError("train_superstep needs user/rate schedules "
+                                 "+ data stacks, or a staged cohort")
+            with timer.phase("stage"):
+                n_dev = self.mesh.shape["clients"]
+                # staticcheck: allow(no-asarray): host schedule normalization;
+                # the packed slots reach the mesh via explicit staging.put only
+                user_schedule = np.asarray(user_schedule, np.int32)
+                rate_schedule = np.asarray(rate_schedule)  # staticcheck: allow(no-asarray): host schedule normalization
+                if user_schedule.shape != rate_schedule.shape \
+                        or user_schedule.ndim != 2 or user_schedule.shape[0] != k:
+                    raise ValueError(
+                        f"user/rate schedules must both be [k={k}, A], got "
+                        f"{user_schedule.shape} / {rate_schedule.shape}")
+                a = user_schedule.shape[1]
+                # slot layout shared with the streaming staging (positions
+                # drive metric reassembly + slot packing in both paths)
+                shape, per_dev, mode, positions, level_rates = \
+                    self._cohort_layout(user_schedule, rate_schedule)
+                sched = self._packer.buffer(("gss", mode, shape), shape)
+                self._fill_schedule(sched, user_schedule, positions,
+                                    level_rates, mode, per_dev, self._slices)
+                args = self._staging.replicated("train_data", data)
+                spec = P(None, None, "clients") if mode == "span" \
+                    else P(None, "clients")
+                sched_dev = self._staging.put(sched, spec=spec)
+                lr_args = (self._staging.scalar(lr),) if lr_arg else ()
+                eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
+                epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
+                # commit the params carry (see train_round), layout pinned
+                global_params = self._staging.commit(self._pin(global_params))
+                prog = self._superstep_prog(k, per_dev, mode,
+                                            eval_mask=eval_mask,
+                                            fused_eval=fused_eval,
+                                            lr_arg=lr_arg)
         with timer.phase("dispatch"):
             out = prog(global_params, base_key, epoch0_dev, *lr_args,
                        sched_dev, *args, *eval_args)

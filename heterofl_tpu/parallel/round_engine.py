@@ -38,7 +38,8 @@ except ImportError:  # pragma: no cover
 from ..data.datasets import DATASET_STATS
 from ..fed.core import combine_counted, round_rates, round_users
 from .ring_attention import ring_attention
-from .staging import PendingMetrics, PhaseTimer, PlacementCache, SlotPacker
+from .staging import (ClientStore, CohortStager, PendingMetrics, PhaseTimer,
+                      PlacementCache, SlotPacker, StagedCohort)
 from ..models.base import ModelDef
 from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks, mask_params, param_mask
@@ -242,6 +243,8 @@ class RoundEngine:
         # per-level sub-engines) never run train_round and skip staging.
         self._staging = PlacementCache(mesh) if mesh is not None else None
         self._packer = SlotPacker()
+        # streaming cohort pipeline (ISSUE 6): built on first stage_cohort
+        self._cohort_stager = None
 
     # ------------------------------------------------------------------
     # per-client local training (pure; vmapped across clients)
@@ -506,8 +509,10 @@ class RoundEngine:
 
         ``user_loc``: this device's slot of active users as indices into its
         local view of the per-user data stacks (== ``user_glob`` under
-        replicated placement); ``user_glob``: the users' global ids, used
-        for all per-client randomness so results are placement- and
+        replicated placement), or ``None`` when the data stacks are already
+        in slot order (the streaming cohort path: slot j's data IS row j, no
+        gather); ``user_glob``: the users' global ids, used for all
+        per-client randomness so results are placement- and
         mesh-shape-invariant.  -1 = padding slot.  ``data`` carries the
         fix-rates table as its last element in fix mode."""
         model, cfg, mesh = self.model, self.cfg, self.mesh
@@ -526,7 +531,7 @@ class RoundEngine:
                 lambda u: jax.random.bernoulli(jax.random.fold_in(fkey, u), failure_rate)
             )(ugid).astype(jnp.float32)
             valid = valid * alive
-        uidx = jnp.maximum(user_loc, 0)
+        uidx = None if user_loc is None else jnp.maximum(user_loc, 0)
         if dynamic:
             # the shared per-round rate stream (fed.core.round_rates):
             # re-roll ALL users, index the active ones (ref fed.py:15-24)
@@ -538,8 +543,8 @@ class RoundEngine:
 
         if self.is_lm:
             all_rows, all_lm = data[0], data[1]
-            rows = all_rows[uidx]
-            lm = all_lm[uidx]
+            rows = all_rows if uidx is None else all_rows[uidx]
+            lm = all_lm if uidx is None else all_lm[uidx]
             n_data = mesh.shape["data"]
             trained, ms = jax.vmap(
                 lambda w_, r_, l_, k_: self._local_train_lm(
@@ -548,7 +553,10 @@ class RoundEngine:
             )(wr, rows, lm, slot_keys)
         else:
             all_x, all_y, all_m, all_lm = data[0], data[1], data[2], data[3]
-            xs, ys, sms, lm = all_x[uidx], all_y[uidx], all_m[uidx], all_lm[uidx]
+            if uidx is None:
+                xs, ys, sms, lm = all_x, all_y, all_m, all_lm
+            else:
+                xs, ys, sms, lm = all_x[uidx], all_y[uidx], all_m[uidx], all_lm[uidx]
             n_data = mesh.shape["data"]
             trained, ms = jax.vmap(
                 lambda w_, x_, y_, m_, l_, k_: self._local_train_vision(
@@ -597,7 +605,7 @@ class RoundEngine:
 
     def _build_superstep(self, k: int, per_dev: int, in_jit: bool,
                          num_active: int = 0, eval_mask=None, fused_eval=None,
-                         lr_arg: bool = False):
+                         lr_arg: bool = False, streaming: bool = False):
         """One jitted+donated program for ``k`` federated rounds: the round
         boundary leaves the host (ISSUE 2 tentpole).
 
@@ -623,14 +631,28 @@ class RoundEngine:
         (round + eval) scan of length k, not k unrolled blocks.
         ``lr_arg=True`` takes the LR as a staged scalar argument instead of
         the traced schedule (ReduceLROnPlateau: LR is constant within a
-        superstep, stepped on eval metrics at superstep boundaries)."""
+        superstep, stepped on eval metrics at superstep boundaries).
+
+        ``streaming=True`` (ISSUE 6): the per-user data stacks are NOT a
+        program invariant -- the sampled cohort's shards ride the scan xs as
+        ``[k, slots, ...]`` stacks sharded over the slot axis (one slot = one
+        device-local cohort row, so the round core indexes identity), and
+        only the tiny fix-rates table stays invariant.  Program memory is
+        O(k x active_clients), independent of the population."""
         mesh = self.mesh
         n_dev = mesh.shape["clients"]
         slots_total = per_dev * n_dev
         num_users = self.cfg["num_users"]
         lr_fn = self._lr_fn
-        data_specs = self._data_specs()
-        n_data_args = len(data_specs)
+        if streaming:
+            n_stream = 2 if self.is_lm else 4
+            n_fix = 1 if self.fix_rates is not None else 0
+            data_specs = (P(None, "clients"),) * n_stream + (P(),) * n_fix
+            sched_specs = (P(None, "clients"),)
+        else:
+            data_specs = self._data_specs()
+            n_data_args = len(data_specs)
+            sched_specs = () if in_jit else (P(None, "clients"), P(None, "clients"))
         groups = superstep_eval_groups(eval_mask) if eval_mask else None
         if groups is not None and not any(ev for _, ev, _ in groups):
             groups = None  # an all-False mask is the plain train superstep
@@ -640,13 +662,29 @@ class RoundEngine:
             if lr_arg:
                 lr_const = rest[0]
                 idx = 1
-            if not in_jit:
-                sched_ul, sched_ug = rest[idx], rest[idx + 1]
-                idx += 2
-            data = rest[idx:idx + n_data_args]
-            eval_ops = rest[idx + n_data_args:]
+            if streaming:
+                sched_ug = rest[idx]
+                idx += 1
+                sdata = rest[idx:idx + n_stream]
+                idx += n_stream
+                fix = rest[idx:idx + n_fix]
+                idx += n_fix
+                eval_ops = rest[idx:]
+            else:
+                if not in_jit:
+                    sched_ul, sched_ug = rest[idx], rest[idx + 1]
+                    idx += 2
+                data = rest[idx:idx + n_data_args]
+                eval_ops = rest[idx + n_data_args:]
 
             def step(p, xs):
+                if streaming:
+                    t, ug, *d = xs
+                    key = jax.random.fold_in(base_key, t)
+                    lr = lr_const if lr_arg else lr_fn(t)
+                    # slot-local cohort rows: user_loc=None = identity gather
+                    return self._round_core(p, key, lr, None, ug,
+                                            tuple(d) + tuple(fix))
                 if in_jit:
                     (t,) = xs
                     key = jax.random.fold_in(base_key, t)
@@ -664,7 +702,10 @@ class RoundEngine:
                 return new_p, ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
-            xs = (epochs,) if in_jit else (epochs, sched_ul, sched_ug)
+            if streaming:
+                xs = (epochs, sched_ug) + tuple(sdata)
+            else:
+                xs = (epochs,) if in_jit else (epochs, sched_ul, sched_ug)
             if groups is None:
                 new_params, ms = jax.lax.scan(step, params, xs)
                 return new_params, ms
@@ -672,7 +713,6 @@ class RoundEngine:
                                    fused_eval, eval_ops)
 
         lr_specs = (P(),) if lr_arg else ()
-        sched_specs = () if in_jit else (P(None, "clients"), P(None, "clients"))
         eval_specs = tuple(fused_eval.specs) if groups else ()
         out_specs = (P(), P(None, "clients"))
         if groups is not None:
@@ -685,11 +725,76 @@ class RoundEngine:
         )
         return jax.jit(fn, donate_argnums=(0,))
 
+    def stage_cohort(self, store: ClientStore, user_schedule,
+                     timer: PhaseTimer = None) -> StagedCohort:
+        """Materialise + commit ONE superstep's cohort from a
+        :class:`~.staging.ClientStore` (ISSUE 6 tentpole).
+
+        ``user_schedule``: int32 ``[k, A]`` active user ids per round (the
+        superstep sampling stream, :func:`~..fed.core.round_users`).  The
+        cohort's shards pack into the stager's ring buffers in the masked
+        engine's slot layout -- schedule order, ``ceil(A / n_dev)`` slots
+        per device, padding slots materialising user 0 exactly like the
+        eager gather -- and commit via explicit ``device_put`` + private
+        copy, sharded over the slot axis.  Host/device cost is
+        O(k x A x shard), independent of the population.  Call it for
+        superstep N+1 right after dispatching superstep N: the device_put
+        pipeline overlaps with N's compute (prefetch depth 1)."""
+        if self._staging is None:
+            raise ValueError("stage_cohort needs a mesh-attached engine")
+        timer = timer if timer is not None else PhaseTimer()
+        with timer.phase("stage"):
+            # staticcheck: allow(no-asarray): host schedule normalization;
+            # the cohort reaches the mesh via the stager's explicit puts only
+            user_schedule = np.asarray(user_schedule, np.int32)
+            if user_schedule.ndim != 2:
+                raise ValueError(
+                    f"user_schedule must be [k, A], got {user_schedule.shape}")
+            k, a = user_schedule.shape
+            n_dev = self.mesh.shape["clients"]
+            per_dev = _ceil_div(a, n_dev)
+            slots = per_dev * n_dev
+            if self._cohort_stager is None:
+                self._cohort_stager = CohortStager(self.mesh)
+            st = self._cohort_stager
+            n = store.shard_max
+            if self.is_lm:
+                layouts = [((k, slots), np.int32, -1),
+                           ((k, slots) + store.row_shape, store.data.dtype, None),
+                           ((k, slots, store.classes_size), np.float32, None)]
+            else:
+                layouts = [((k, slots), np.int32, -1),
+                           ((k, slots, n) + store.data.shape[1:],
+                            store.data.dtype, None),
+                           ((k, slots, n), store.target.dtype, None),
+                           ((k, slots, n), np.float32, None),
+                           ((k, slots, store.classes_size), np.float32, None)]
+            key = ("masked", k, slots)
+            slot_i, bufs = st.buffers(key, layouts)
+            sched = bufs[0]
+            sched[:, :a] = user_schedule  # trailing slots stay -1 (padding)
+            flat = sched.reshape(-1)
+            if self.is_lm:
+                store.fill_lm(flat, bufs[1].reshape((-1,) + bufs[1].shape[2:]))
+                store.fill_labels(flat, bufs[2].reshape(-1, store.classes_size))
+            else:
+                store.fill_vision(flat,
+                                  bufs[1].reshape((-1,) + bufs[1].shape[2:]),
+                                  bufs[2].reshape((-1,) + bufs[2].shape[2:]),
+                                  bufs[3].reshape(-1, n))
+                store.fill_labels(flat, bufs[4].reshape(-1, store.classes_size))
+            dev = st.commit(key, slot_i, bufs,
+                            (P(None, "clients"),) * len(bufs))
+        return StagedCohort(engine="masked", k=k, a=a, per_dev=per_dev,
+                            sched=dev[0], data=tuple(dev[1:]))
+
     def train_superstep(self, params, base_key, epoch0: int, k: int,
-                        data: Tuple[jnp.ndarray, ...], user_schedule=None,
+                        data: Optional[Tuple[jnp.ndarray, ...]] = None,
+                        user_schedule=None,
                         num_active: Optional[int] = None,
                         timer: PhaseTimer = None, eval_mask=None,
-                        fused_eval=None, lr: Optional[float] = None):
+                        fused_eval=None, lr: Optional[float] = None,
+                        cohort: Optional[StagedCohort] = None):
         """Run ``k`` rounds as ONE compiled program (``superstep_rounds``).
 
         Per-round keys are ``fold_in(base_key, epoch0 + r)`` -- the driver's
@@ -708,12 +813,50 @@ class RoundEngine:
         fetch then yields ``{"train": [k dicts], "eval": [per-eval dicts]}``
         with each eval dict carrying ``epoch``/``bn``/``local``/``global``.
         ``lr``: stage a constant LR scalar instead of the traced schedule
-        (the ReduceLROnPlateau superstep mode)."""
+        (the ReduceLROnPlateau superstep mode).
+
+        ``cohort`` (ISSUE 6): a :class:`~.staging.StagedCohort` from
+        :meth:`stage_cohort` replaces ``data`` entirely -- the cohort's
+        shards ride the scan xs and the program never sees the population.
+        The slot layout and sampling stream match the in-jit draw, so a
+        streamed superstep is bit-identical to the eager one."""
         eval_mask = normalize_eval_mask(eval_mask, k, fused_eval)
         lr_arg = lr is not None
         if not lr_arg and self._lr_fn is None:
             self._lr_fn = make_traced_lr_fn(self.cfg)
         timer = timer if timer is not None else PhaseTimer()
+        if cohort is not None:
+            if cohort.engine != "masked" or cohort.k != k:
+                raise ValueError(
+                    f"cohort mismatch: staged for engine={cohort.engine!r} "
+                    f"k={cohort.k}, dispatching masked k={k}")
+            with timer.phase("stage"):
+                a, per_dev = cohort.a, cohort.per_dev
+                sched_args = (cohort.sched,)
+                args = tuple(cohort.data)
+                if self.fix_rates is not None:
+                    args = args + self._staging.replicated(
+                        "fix_rates", (self.fix_rates,))
+                lr_args = (self._staging.scalar(lr),) if lr_arg else ()
+                eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
+                epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
+                params = self._staging.commit(self._pin(params))
+                pkey = (k, per_dev, "stream", a, eval_mask, lr_arg)
+                prog = self._superstep_progs.get(pkey)
+                if prog is None:
+                    prog = self._build_superstep(k, per_dev, False,
+                                                 num_active=a,
+                                                 eval_mask=eval_mask,
+                                                 fused_eval=fused_eval,
+                                                 lr_arg=lr_arg, streaming=True)
+                    self._superstep_progs[pkey] = prog
+            with timer.phase("dispatch"):
+                out = prog(params, base_key, epoch0_dev, *lr_args,
+                           *sched_args, *args, *eval_args)
+            return self._assemble_superstep(out, epoch0, k, eval_mask,
+                                            fused_eval)
+        if data is None:
+            raise ValueError("train_superstep needs data stacks or a cohort")
         with timer.phase("stage"):
             n_dev = self.mesh.shape["clients"]
             sched_args = ()
@@ -792,7 +935,12 @@ class RoundEngine:
         with timer.phase("dispatch"):
             out = prog(params, base_key, epoch0_dev, *lr_args, *sched_args,
                        *args, *eval_args)
+        return self._assemble_superstep(out, epoch0, k, eval_mask, fused_eval)
 
+    def _assemble_superstep(self, out, epoch0: int, k: int, eval_mask,
+                            fused_eval):
+        """Package one superstep dispatch's outputs: ``(new_params,
+        PendingMetrics)``; shared by the eager and streaming paths."""
         if eval_mask is None:
             new_params, ms = out
 

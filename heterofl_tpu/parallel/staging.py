@@ -33,6 +33,27 @@ Four pieces remove that tax:
   threaded into ``bench.py``'s ``extra`` dict and the fed drivers' per-round
   info line, so placement regressions show up as a phase shift instead of
   an undifferentiated slowdown.
+
+Streaming population staging (ISSUE 6) adds the input-side twins:
+
+* :class:`ClientStore` -- the federation's user population as an
+  O(1)-per-user METADATA index over the raw dataset arrays (per-user sample
+  index rows or contiguous spans, per-user label sets), never densified
+  into ``[num_users, ...]`` stacks.  Only the sampled cohort's shards are
+  materialised, so host and device memory scale with ``active_clients``
+  instead of the population -- "millions of users" becomes a config value.
+* :class:`CohortStager` -- the double-buffered ``device_put`` pipeline:
+  superstep N+1's cohort packs into a ring of :class:`SlotPacker` host
+  buffers and commits to the mesh (explicit ``device_put`` + jitted private
+  copy) while superstep N's scanned program computes.  A ring slot is
+  refilled only after its previous private COPY is ready -- the copy severs
+  any ``device_put`` host-buffer aliasing, so buffer reuse can never
+  corrupt an in-flight superstep (same hazard :meth:`PlacementCache.put`
+  documents, solved by pipelining instead of a per-call defensive copy).
+* :class:`StagedCohort` -- one superstep's committed cohort (slot schedule
+  + data stacks as scan xs) plus the static layout facts the dispatching
+  engine needs; built by the engines' ``stage_cohort`` and consumed by
+  ``train_superstep(..., cohort=...)``.
 """
 
 from __future__ import annotations
@@ -196,23 +217,26 @@ class PlacementCache:
 class SlotPacker:
     """Cached host-side slot packing.
 
-    ``buffer(key, shape)`` returns a preallocated int32 buffer filled with
-    -1 (the padding-slot id); callers write the active ids in place.  The
-    per-round numpy packing previously reallocated identical layouts
-    whenever the active-client count repeated -- with a fixed ``frac`` that
-    is every round.
+    ``buffer(key, shape)`` returns a preallocated buffer (int32 filled with
+    -1, the padding-slot id, by default); callers write the active ids in
+    place.  The per-round numpy packing previously reallocated identical
+    layouts whenever the active-client count repeated -- with a fixed
+    ``frac`` that is every round.  ``fill=None`` skips the fill for buffers
+    whose every row is overwritten (the streaming cohort data stacks).
     """
 
     def __init__(self):
         self._bufs: Dict[Any, np.ndarray] = {}
 
-    def buffer(self, key, shape: Tuple[int, ...]) -> np.ndarray:
+    def buffer(self, key, shape: Tuple[int, ...], dtype=np.int32,
+               fill=-1) -> np.ndarray:
         shape = tuple(shape)
         buf = self._bufs.get(key)
-        if buf is None or buf.shape != shape:
-            buf = np.empty(shape, np.int32)
+        if buf is None or buf.shape != shape or buf.dtype != np.dtype(dtype):
+            buf = np.empty(shape, dtype)
             self._bufs[key] = buf
-        buf.fill(-1)
+        if fill is not None:
+            buf.fill(fill)
         return buf
 
 
@@ -309,3 +333,265 @@ class MetricsPipeline:
 
     def __len__(self) -> int:
         return len(self._pending)
+
+
+# ---------------------------------------------------------------------------
+# Streaming population staging (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def _idx64(a) -> np.ndarray:
+    """Host index/label-metadata normalization for the ClientStore.
+
+    # staticcheck: allow(no-asarray): host int64 METADATA coercion -- never
+    # wraps a device array; cohort bytes reach the mesh only through the
+    # CohortStager's explicit device_put.
+    """
+    return np.asarray(a, np.int64)  # staticcheck: allow(no-asarray): see docstring
+
+
+class ClientStore:
+    """The population as an O(1)-per-user metadata index; cohort shards
+    materialise on demand.
+
+    Holds references to the RAW dataset arrays (images/targets or batchified
+    token rows) plus per-user index metadata in one of two layouts:
+
+    * **CSR** (:meth:`from_split`): the driver's ``data_split`` index lists
+      flattened into one int64 array with per-user offsets -- O(total
+      samples) metadata, exactly what the split dict already holds, minus
+      the per-user Python-list overhead.
+    * **spans** (:meth:`from_spans`): per-user ``(start, size)`` contiguous
+      ranges into the raw arrays -- O(num_users) metadata, the layout the
+      million-user synthetic populations use (users window onto a shared
+      sample pool; ``data/partition.span_population`` builds one).
+
+    ``fill_*`` gather the SAMPLED users' shards into caller buffers with
+    byte-identical layout to the eager ``data.pipeline.stack_client_shards``
+    rows (same repeat-first-items padding, same sample masks, same label
+    masks), so a streamed cohort reproduces the eager round bit for bit.
+    Padding slots (user id -1) materialise user 0's shard -- the engines'
+    ``maximum(uid, 0)`` convention -- so padded-slot local training stays
+    finite exactly like the eager path; its results never reach aggregation
+    or metrics (masked by ``valid``).
+    """
+
+    def __init__(self, data, target, sizes, classes_size, *, starts=None,
+                 offsets=None, idx=None, label_offsets=None, label_idx=None,
+                 kind="vision"):
+        self.kind = kind
+        self.data = np.ascontiguousarray(data)
+        self.target = None if target is None else np.ascontiguousarray(target)
+        self.sizes = _idx64(sizes)
+        self.classes_size = int(classes_size)
+        self._starts = None if starts is None else _idx64(starts)
+        self._off = None if offsets is None else _idx64(offsets)
+        self._idx = None if idx is None else _idx64(idx)
+        self._loff = None if label_offsets is None else _idx64(label_offsets)
+        self._lidx = None if label_idx is None else _idx64(label_idx)
+        if (self._starts is None) == (self._off is None):
+            raise ValueError("ClientStore needs exactly one of spans or CSR index")
+        if self.sizes.size == 0 or (self.sizes <= 0).any():
+            raise ValueError("every user needs a non-empty shard")
+        self.num_users = int(self.sizes.size)
+        self.shard_max = int(self.sizes.max())
+        if kind == "lm" and (self.sizes != self.shard_max).any():
+            raise ValueError("per-user row counts must match")  # stack parity
+
+    # -- constructors --------------------------------------------------
+
+    @classmethod
+    def from_split(cls, data, target, data_split: Dict[int, Sequence[int]],
+                   label_split, classes_size: int, kind: str = "vision"
+                   ) -> "ClientStore":
+        """Build from the driver's per-user index-list dicts (the eager
+        stack's inputs)."""
+        users = len(data_split)
+        rows = [_idx64(data_split[u]) for u in range(users)]
+        sizes = _idx64([r.size for r in rows])
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+        idx = np.concatenate(rows) if rows else np.zeros(0, np.int64)
+        loff = lidx = None
+        if label_split is not None:
+            lrows = [_idx64(label_split[u]) for u in range(users)]
+            loff = np.concatenate([[0], np.cumsum([r.size for r in lrows])])
+            lidx = np.concatenate(lrows) if lrows else np.zeros(0, np.int64)
+        return cls(data, target, sizes, classes_size, offsets=offsets, idx=idx,
+                   label_offsets=loff, label_idx=lidx, kind=kind)
+
+    @classmethod
+    def from_spans(cls, data, target, starts, sizes, classes_size,
+                   label_split=None, kind: str = "vision") -> "ClientStore":
+        """Build from per-user contiguous ``(start, size)`` windows into the
+        raw arrays: O(num_users) metadata, the million-user layout.
+        ``label_split=None`` means every user sees every class (iid)."""
+        starts = _idx64(starts)
+        sizes = _idx64(sizes)
+        if starts.shape != sizes.shape:
+            raise ValueError(f"starts/sizes shape mismatch: {starts.shape} vs "
+                             f"{sizes.shape}")
+        if ((starts < 0) | (starts + sizes > len(data))).any():
+            raise ValueError("a user span runs outside the raw data array")
+        loff = lidx = None
+        if label_split is not None:
+            lrows = [_idx64(label_split[u]) for u in range(len(starts))]
+            loff = np.concatenate([[0], np.cumsum([r.size for r in lrows])])
+            lidx = np.concatenate(lrows) if lrows else np.zeros(0, np.int64)
+        return cls(data, target, sizes, classes_size, starts=starts,
+                   label_offsets=loff, label_idx=lidx, kind=kind)
+
+    # -- metadata ------------------------------------------------------
+
+    @property
+    def metadata_nbytes(self) -> int:
+        """Host bytes of the index metadata (the raw data pool is shared
+        with the dataset and excluded): the O(active)-memory tests compare
+        this against the eager ``[U, N, ...]`` stack it replaces."""
+        return sum(a.nbytes for a in (self.sizes, self._starts, self._off,
+                                      self._idx, self._loff, self._lidx)
+                   if a is not None)
+
+    @property
+    def row_shape(self) -> Tuple[int, ...]:
+        """Per-user shard shape at the store-wide static max: vision
+        ``(shard_max,) + sample_shape``, LM ``(rows, row_len)``."""
+        return (self.shard_max,) + self.data.shape[1:]
+
+    def _row_idx(self, u: int, n: int) -> np.ndarray:
+        """User ``u``'s padded sample-index row of length ``n`` -- the exact
+        ``stack_client_shards`` rule: real indices first, then the first
+        ``n - size`` indices repeated cyclically."""
+        sz = int(self.sizes[u])
+        j = np.arange(n)
+        jj = np.where(j < sz, j, (j - sz) % sz)
+        if self._starts is not None:
+            return int(self._starts[u]) + jj
+        lo = int(self._off[u])
+        return self._idx[lo:lo + sz][jj]
+
+    @staticmethod
+    def _slot_user(u) -> int:
+        # padding slots (-1) materialise user 0: the engines gather data at
+        # maximum(uid, 0), so this is the eager stack's exact behaviour
+        u = int(u)
+        return u if u >= 0 else 0
+
+    # -- cohort materialisation ----------------------------------------
+
+    def fill_vision(self, user_ids, x_out: np.ndarray, y_out: np.ndarray,
+                    m_out: np.ndarray) -> None:
+        """Gather the given users' shards into ``[slots, shard_max, ...]``
+        buffers (images, targets, sample masks)."""
+        n = x_out.shape[1]
+        ids = _idx64(user_ids).reshape(-1)
+        for s, u in enumerate(ids):
+            u = self._slot_user(u)
+            idx = self._row_idx(u, n)
+            x_out[s] = self.data[idx]
+            y_out[s] = self.target[idx]
+            sz = int(self.sizes[u])
+            m_out[s, :sz] = 1.0
+            m_out[s, sz:] = 0.0
+
+    def fill_lm(self, user_ids, rows_out: np.ndarray) -> None:
+        """Gather the given users' batchified token rows into
+        ``[slots, rows, row_len]``."""
+        ids = _idx64(user_ids).reshape(-1)
+        for s, u in enumerate(ids):
+            u = self._slot_user(u)
+            rows_out[s] = self.data[self._row_idx(u, rows_out.shape[1])]
+
+    def fill_labels(self, user_ids, lm_out: np.ndarray) -> None:
+        """Per-user label-split masks ``[slots, classes]`` -- the streaming
+        twin of ``data.pipeline.label_split_masks`` rows.  A store built
+        without a label split (iid span populations) emits all-ones."""
+        ids = _idx64(user_ids).reshape(-1)
+        if self._lidx is None:
+            lm_out[:] = 1.0
+            return
+        lm_out[:] = 0.0
+        for s, u in enumerate(ids):
+            u = self._slot_user(u)
+            lm_out[s, self._lidx[self._loff[u]:self._loff[u + 1]]] = 1.0
+
+
+class StagedCohort:
+    """One superstep's committed cohort: the slot schedule + data stacks
+    (device-resident, sharded over the cohort's slot axis, consumed as scan
+    xs) plus the static layout facts that key the streaming program."""
+
+    def __init__(self, engine: str, k: int, a: int, per_dev: int, sched,
+                 data: Tuple, mode: Optional[str] = None,
+                 positions: Optional[list] = None):
+        self.engine = engine        # "masked" | "grouped"
+        self.k = k                  # rounds in the superstep
+        self.a = a                  # active clients per round
+        self.per_dev = per_dev      # slots per device (per level, grouped)
+        self.sched = sched          # device [k, ...] slot-id schedule
+        self.data = data            # device cohort stacks, k-leading
+        self.mode = mode            # grouped: "span" | "slices"
+        self.positions = positions  # grouped: per-round per-level A-positions
+
+
+class CohortStager:
+    """Double-buffered cohort commit: host ring buffers -> explicit
+    ``device_put`` -> jitted private copy.
+
+    The pipeline contract: ``buffers()`` hands out one ring slot's host
+    buffers to fill, ``commit()`` moves them to the mesh and returns PRIVATE
+    device arrays.  ``device_put`` may zero-copy-alias an aligned host
+    buffer for the device array's whole lifetime (the
+    :meth:`PlacementCache.put` finding), so the committed arrays are a
+    jitted replicate-copy of the put -- the copy dispatches asynchronously
+    (it IS the overlap-able transfer) and its outputs share no buffers with
+    the ring.  Before a ring slot is handed out again, ``buffers()`` blocks
+    on that slot's previous COPY outputs: once the copy is ready its inputs
+    are dead, so the refill can never corrupt an in-flight superstep -- and
+    with prefetch depth 1 the wait lands two supersteps after the copy
+    dispatched, i.e. it is effectively free.
+    """
+
+    def __init__(self, mesh: Mesh, depth: int = 1):
+        self.mesh = mesh
+        self.depth = max(1, int(depth))
+        self._packer = SlotPacker()
+        self._cursor: Dict[Any, int] = {}
+        self._fences: Dict[Any, Any] = {}
+        self._copiers: Dict[Any, Any] = {}
+
+    def buffers(self, key, layouts: Sequence[Tuple]) -> Tuple[int, Tuple[np.ndarray, ...]]:
+        """One ring slot's host buffers for ``layouts`` = [(shape, dtype,
+        fill), ...]; returns ``(slot, buffers)``.  Blocks on the slot's
+        previous private copy (see class docstring) before reuse."""
+        slot = self._cursor.get(key, 0)
+        fence = self._fences.pop((key, slot), None)
+        if fence is not None:
+            # staticcheck: allow(no-block-until-ready): the ring-slot fence
+            # waits on the prior private COPY of these buffers (a memcpy that
+            # finished supersteps ago), never on a round program
+            jax.block_until_ready(fence)
+        bufs = tuple(self._packer.buffer((key, slot, i), shape, dtype, fill)
+                     for i, (shape, dtype, fill) in enumerate(layouts))
+        return slot, bufs
+
+    def _copier(self, sig, shardings):
+        fn = self._copiers.get(sig)
+        if fn is None:
+            # staticcheck: allow(jit-needs-donation): the whole point of this
+            # jit is to MATERIALISE private buffers severing any device_put
+            # host aliasing -- donating its input would re-alias the ring
+            fn = jax.jit(lambda t: tuple(a + 0 for a in t),
+                         out_shardings=tuple(shardings))
+            self._copiers[sig] = fn
+        return fn
+
+    def commit(self, key, slot: int, bufs: Sequence[np.ndarray],
+               specs: Sequence[P]) -> Tuple:
+        """Commit one ring slot's buffers to the mesh with ``specs`` and
+        return the private device arrays; advances the ring cursor."""
+        shardings = tuple(NamedSharding(self.mesh, s) for s in specs)
+        put = tuple(jax.device_put(b, sh) for b, sh in zip(bufs, shardings))
+        sig = tuple((b.shape, b.dtype.str, s) for b, s in zip(bufs, specs))
+        out = self._copier(sig, shardings)(put)
+        self._fences[(key, slot)] = out
+        self._cursor[key] = (slot + 1) % (self.depth + 1)
+        return out

@@ -1,8 +1,9 @@
 """Front 1: the compiled-program auditor.
 
 Lowers every flagship round-program variant -- masked + grouped engines x
-replicated/sharded (masked) and span/slices (grouped) placements x
-``superstep_rounds`` in {1, 8} -- on a CPU mesh and statically enforces:
+replicated/sharded/streaming-cohort (masked) and span/slices/streaming
+(grouped) placements x ``superstep_rounds`` in {1, 8} -- on a CPU mesh and
+statically enforces:
 
 (a) **no host callbacks** (``pure_callback``/``io_callback``/
     ``debug_callback``) and **no f64** anywhere in a round program;
@@ -137,9 +138,17 @@ def build_setup(flagship: bool = False, seed: int = 0) -> Dict[str, Any]:
     sbn, local, glob = stage_eval_operands(cfg, ds["train"], ds["test"],
                                            split["test"], lm)
     eval_data = {"sbn": sbn, "local": local, "global": glob}
+
+    # streaming population store (ISSUE 6): the same split as the eager
+    # stacks, so the streamed audit variants stage bit-identical cohorts
+    from ..parallel import ClientStore
+
+    store = ClientStore.from_split(ds["train"].data, ds["train"].target,
+                                   split["train"], lsplit, 10)
     return {"cfg": cfg, "data": data, "model": model, "params": params,
             "mesh": mesh, "flagship": flagship, "key": jax.random.key(seed),
-            "lr": np.float32(0.05), "users": users, "eval_data": eval_data}
+            "lr": np.float32(0.05), "users": users, "eval_data": eval_data,
+            "store": store}
 
 
 def fused_eval_for(setup):
@@ -225,6 +234,30 @@ def _masked_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
         {"donated": n_leaves, "psum": PSUM_BUDGET,
          "psum_eval": EVAL_PSUM_BUDGET}))
 
+    # streaming cohort superstep (ISSUE 6): the cohort's data stacks ride
+    # the scan xs; the program never sees the population.  The staged
+    # cohort's REAL committed arrays are the example args (audit only
+    # traces/lowers), so the audited layout is the engine's own staging.
+    from ..fed.core import superstep_user_schedule
+
+    sched = superstep_user_schedule(key, 1, k, users, a)
+    coh = eng.stage_cohort(setup["store"], sched)
+    targets.append((
+        "masked/stream/k8",
+        eng._build_superstep(k, coh.per_dev, False, num_active=coh.a,
+                             streaming=True),
+        (params, key, np.int32(1), coh.sched) + tuple(coh.data) + fix,
+        {"donated": n_leaves, "psum": PSUM_BUDGET}))
+    targets.append((
+        "masked/stream/k8-eval1",
+        eng._build_superstep(k, coh.per_dev, False, num_active=coh.a,
+                             eval_mask=(True,) * k, fused_eval=fe,
+                             streaming=True),
+        (params, key, np.int32(1), coh.sched) + tuple(coh.data) + fix
+        + tuple(fe.ops),
+        {"donated": n_leaves, "psum": PSUM_BUDGET,
+         "psum_eval": EVAL_PSUM_BUDGET * k}))
+
     # sharded: per-user stacks device-sharded over the clients axis
     eng_sh = RoundEngine(model, dict(cfg, data_placement="sharded"), mesh)
     eng_sh._lr_fn = make_traced_lr_fn(cfg)
@@ -304,6 +337,20 @@ def _grouped_targets(setup) -> Tuple[List, Dict[str, float], Any]:
         {"donated": n_leaves, "psum": PSUM_BUDGET,
          "psum_eval": EVAL_PSUM_BUDGET * k}))
 
+    # streaming cohort superstep (ISSUE 6): level-grouped cohort stacks as
+    # scan xs, staged through the engine's own cohort pipeline
+    from ..fed.core import superstep_rate_schedule, superstep_user_schedule
+
+    a_stream = cfg["num_users"]  # every user active: all levels populated
+    sched_st = superstep_user_schedule(key, 1, k, cfg["num_users"], a_stream)
+    rates_st = superstep_rate_schedule(key, 1, k, cfg, sched_st)
+    coh = grp.stage_cohort(setup["store"], sched_st, rates_st)
+    targets.append((
+        "grouped/stream/span/k8",
+        grp._superstep_prog(k, coh.per_dev, "span", streaming=True),
+        (params, key, np.int32(1), coh.sched) + tuple(coh.data),
+        {"donated": n_leaves, "psum": PSUM_BUDGET}))
+
     grp_sl = GroupedRoundEngine(dict(cfg, level_placement="slices"), mesh)
     grp_sl._lr_fn = make_traced_lr_fn(cfg)
     if grp_sl.level_placement == "slices":
@@ -335,6 +382,13 @@ def _grouped_targets(setup) -> Tuple[List, Dict[str, float], Any]:
                 + data + tuple(fe.ops),
                 {"donated": n_leaves, "psum": PSUM_BUDGET,
                  "psum_eval": EVAL_PSUM_BUDGET * k}))
+            coh_sl = grp_sl.stage_cohort(setup["store"], sched_st, rates_st)
+            targets.append((
+                "grouped/stream/slices/k8",
+                grp_sl._superstep_prog(k, coh_sl.per_dev, "slices",
+                                       streaming=True),
+                (params, key, np.int32(1), coh_sl.sched) + tuple(coh_sl.data),
+                {"donated": n_leaves, "psum": PSUM_BUDGET}))
     return targets, level_prog_names, grp_sl
 
 
@@ -528,6 +582,45 @@ def recompile_hazard_check(setup) -> Dict[str, Any]:
     pend.fetch()
     out["masked_sharded_superstep"] = {"after_warm": size1,
                                        "after_repeat": eng_sh.program_cache_size()}
+
+    # streaming cohort supersteps (ISSUE 6): every superstep restages a
+    # FRESH cohort (new host buffers, new device arrays) -- the program key
+    # is the static layout (k, per_dev, stream), so steady-state streaming
+    # must stay one compiled specialization per engine
+    from ..fed.core import superstep_rate_schedule, superstep_user_schedule
+
+    store = setup["store"]
+    eng_st = RoundEngine(model, cfg, mesh)
+    pst = model.init(jax.random.key(0))
+
+    def fresh_cohort(epoch0):
+        sched = superstep_user_schedule(base, epoch0, 2, setup["users"], 4)
+        return eng_st.stage_cohort(store, sched)
+
+    pst, pend = eng_st.train_superstep(pst, base, 1, 2, cohort=fresh_cohort(1))
+    pend.fetch()
+    size1 = eng_st.program_cache_size()
+    pst, pend = eng_st.train_superstep(pst, base, 3, 2, cohort=fresh_cohort(3))
+    pend.fetch()
+    out["masked_stream_superstep"] = {"after_warm": size1,
+                                      "after_repeat": eng_st.program_cache_size()}
+
+    grp_st = GroupedRoundEngine(cfg, mesh)
+    gst = model.init(jax.random.key(0))
+
+    def fresh_gcohort(epoch0):
+        sched = superstep_user_schedule(base, epoch0, 2, setup["users"],
+                                        setup["users"])
+        rates = superstep_rate_schedule(base, epoch0, 2, cfg, sched)
+        return grp_st.stage_cohort(store, sched, rates)
+
+    gst, pend = grp_st.train_superstep(gst, base, 1, 2, cohort=fresh_gcohort(1))
+    pend.fetch()
+    size1 = grp_st.program_cache_size()
+    gst, pend = grp_st.train_superstep(gst, base, 3, 2, cohort=fresh_gcohort(3))
+    pend.fetch()
+    out["grouped_stream_superstep"] = {"after_warm": size1,
+                                       "after_repeat": grp_st.program_cache_size()}
 
     grp = GroupedRoundEngine(cfg, mesh)
     rates_vec = np.asarray(cfg["model_rate"], np.float32)

@@ -52,6 +52,9 @@ class Rule:
     methods: Tuple[str, ...] = ()
     builtins: Tuple[str, ...] = ()
     require_kwargs: Tuple[str, ...] = ()
+    #: flag a function-body ``import x`` whose name is already bound by a
+    #: module-level import (a shadowed inline re-import)
+    shadowed_imports: bool = False
 
     def applies_to(self, relpath: str) -> bool:
         rp = relpath.replace(os.sep, "/")
@@ -110,6 +113,13 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
          _PARALLEL,
          calls=("jax.jit",),
          require_kwargs=("donate_argnums", "donate_argnames")),
+    Rule("no-shadowed-inline-import",
+         "inline import of a module the file already imports at module "
+         "level: dead weight that shadows the top-level binding for its "
+         "scope and hides which imports a function really adds (the "
+         "entry/common.py `import math` regression)",
+         _DRIVER,
+         shadowed_imports=True),
     Rule("no-host-eval-in-driver",
          "host-side eval dispatch in the driver loop: with "
          "superstep_rounds>1 the sBN+eval phases run INSIDE the fused "
@@ -211,6 +221,39 @@ def lint_source(src: str, relpath: str,
         findings.append(Finding(
             rule.id, f"{relpath}:{node.lineno}",
             f"{what}: {rule.description}"))
+
+    shadow_rules = [r for r in active if r.shadowed_imports]
+    if shadow_rules:
+        top_names = set()
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    top_names.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    top_names.add(a.asname or a.name)
+        # only imports nested in a function body create a shadowing local
+        # scope; module-level conditional imports (try/except fallbacks,
+        # platform guards) legitimately rebind the module name
+        fn_imports: List[ast.AST] = []
+        seen_ids: Set[int] = set()
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.Import, ast.ImportFrom)) \
+                        and id(sub) not in seen_ids:
+                    seen_ids.add(id(sub))
+                    fn_imports.append(sub)
+        for node in fn_imports:
+            for a in node.names:
+                name = a.asname or (a.name if isinstance(node, ast.ImportFrom)
+                                    else a.name.split(".")[0])
+                if name in top_names:
+                    for rule in shadow_rules:
+                        report(rule, node, f"inline import of {name!r} "
+                               f"already imported at module level")
+                    break
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):

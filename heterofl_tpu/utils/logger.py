@@ -55,6 +55,19 @@ class Logger:
         self.counter = defaultdict(float)
         self.mean = defaultdict(float)
 
+    def reset_tag(self, tag: str) -> None:
+        """Clear ONE tag's running means/counters (history untouched).
+
+        The eval-fused superstep logs several evals between two ``reset()``
+        boundaries; resetting the ``test`` tag before each fused eval keeps
+        every eval's means standalone -- the K=1 host loop's semantics,
+        where ``reset()`` runs every round (the best-checkpoint pivot and
+        ReduceLROnPlateau both read these means)."""
+        prefix = f"{tag}/"
+        for d in (self.counter, self.mean):
+            for k in [k for k in d if k.startswith(prefix)]:
+                del d[k]
+
     # -- persistence (ref utils.py:302-312 pickles the whole Logger; here the
     # state rides inside the checkpoint blob so resume-mode 1 restores running
     # means/counters and TB step counters, not just history) ---------------

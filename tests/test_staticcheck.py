@@ -513,6 +513,60 @@ def test_scan_body_kernel_count_parses_hlo():
     assert body["body"] in stats and body["instructions"] > 0
 
 
+def test_shadowed_inline_import_rule():
+    """ISSUE 6 satellite: a function-body import of a module the file
+    already imports at module level is flagged in entry/ (the
+    entry/common.py inline `import math` regression); genuinely lazy
+    imports (name not bound at module level) stay legal, and the pragma
+    suppresses with a reason."""
+    src = """
+    import math
+    import json
+
+    def f(x):
+        import math
+        return math.ceil(x)
+    """
+    fs = _lint(src, "heterofl_tpu/entry/common.py")
+    assert [f.rule for f in fs] == ["no-shadowed-inline-import"]
+    # scoped to entry/: engine code may structure imports freely
+    assert _lint(src, "heterofl_tpu/parallel/engine.py") == []
+    # a lazy import of something NOT bound at module level is fine
+    assert _lint("""
+    import math
+
+    def f():
+        from heterofl_tpu.parallel.grouped import GroupedRoundEngine
+        return GroupedRoundEngine
+    """, "heterofl_tpu/entry/common.py") == []
+    # from-import shadowing counts; aliases resolve by bound name
+    fs = _lint("""
+    from os import path
+
+    def g():
+        from os import path
+        return path
+    """, "heterofl_tpu/entry/x.py")
+    assert [f.rule for f in fs] == ["no-shadowed-inline-import"]
+    assert _lint("""
+    import math
+
+    def f():
+        import math  # staticcheck: allow(no-shadowed-inline-import): reason
+        return math
+    """, "heterofl_tpu/entry/x.py") == []
+    # module-level conditional imports (try/except fallback, platform
+    # guard) rebind the module name on purpose -- not a shadow
+    assert _lint("""
+    import json
+
+    try:
+        import ujson as json
+    except ImportError:
+        import json
+    """, "heterofl_tpu/entry/x.py") == []
+
+
 def test_lint_scope_covers_ops_and_models():
     """ISSUE 5 satellite: the banned-call rules now apply to ops/ and
     models/ (kernel/model code runs INSIDE the round programs)."""

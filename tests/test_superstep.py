@@ -376,12 +376,15 @@ def test_driver_superstep_config_conflicts(tmp_path):
                               scheduler_name="ReduceLROnPlateau"), 0)
     FedExperiment(_driver_cfg(tmp_path, superstep_rounds=2, eval_interval=4,
                               scheduler_name="ReduceLROnPlateau"), 0)
-    # RELAXED: metrics_fetch_every may defer WHOLE supersteps (any multiple
-    # of K); == K remains the unified fetch batch
+    # metrics_fetch_every == K stays the unified per-superstep fetch batch
     FedExperiment(_driver_cfg(tmp_path, superstep_rounds=2, eval_interval=2,
                               metrics_fetch_every=2), 0)
-    FedExperiment(_driver_cfg(tmp_path, superstep_rounds=2, eval_interval=2,
-                              metrics_fetch_every=4), 0)
+    # TIGHTENED (ISSUE 6 satellite): deferring WHOLE supersteps made
+    # pivot_fresh never true -- best-checkpoint tracking silently stopped;
+    # now a loud config error like every comparable knob conflict
+    with pytest.raises(ValueError, match="best-checkpoint"):
+        FedExperiment(_driver_cfg(tmp_path, superstep_rounds=2, eval_interval=2,
+                                  metrics_fetch_every=4), 0)
 
 
 @pytest.mark.slow
