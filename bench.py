@@ -145,11 +145,18 @@ def _load_staticcheck():
     progs = rec.get("programs") or {}
     mem = {name: (p.get("memory") or {}).get("temp_size_in_bytes")
            for name, p in progs.items()}
+    # ratchet summary (ISSUE 7): ratchet_ok is None when the artifact was
+    # produced without --diff-baseline; a checked-and-regressed ratchet
+    # blocks recording the same way a failing audit does (see main)
+    ratchet = rec.get("ratchet") or {}
     return {"ok": bool(rec.get("ok")),
             "stale": newest_src > artifact_mtime,
             "generated_at": rec.get("generated_at"),
             "programs_audited": len(progs),
             "lint_findings": len(rec.get("lint") or []),
+            "ratchet_ok": (bool(ratchet.get("ok"))
+                           if ratchet.get("checked") else None),
+            "ratchet_regressions": len(ratchet.get("regressions") or []),
             "program_temp_bytes": {k: v for k, v in mem.items() if v}}
 
 
@@ -353,16 +360,20 @@ def main():
     # a STALE one (older than the newest package source) neither blocks nor
     # vouches -- extra.staticcheck carries the stale flag either way.
     staticcheck = _load_staticcheck()
-    if staticcheck is not None and not staticcheck["ok"] \
+    if staticcheck is not None \
+            and (not staticcheck["ok"] or staticcheck["ratchet_ok"] is False) \
             and not staticcheck["stale"] \
             and os.environ.get("BENCH_SKIP_STATICCHECK") != "1":
+        what = ("a failing program audit" if not staticcheck["ok"]
+                else "a regressed baseline ratchet")
         print(json.dumps({
             "metric": "federated_rounds_per_sec_cifar10_resnet18_a1-e1_100c",
             "value": 0.0, "unit": "rounds/sec", "vs_baseline": None,
-            "extra": {"error": "STATICCHECK.json reports a failing program "
-                               "audit; refusing to record a bench run. Rerun "
-                               "`python -m heterofl_tpu.staticcheck` (or set "
-                               "BENCH_SKIP_STATICCHECK=1 to override).",
+            "extra": {"error": f"STATICCHECK.json reports {what}; refusing "
+                               f"to record a bench run. Rerun `python -m "
+                               f"heterofl_tpu.staticcheck --diff-baseline` "
+                               f"(or set BENCH_SKIP_STATICCHECK=1 to "
+                               f"override).",
                       "staticcheck": staticcheck},
         }), flush=True)
         return
@@ -496,6 +507,29 @@ def main():
     from heterofl_tpu.fed.core import level_flop_table
 
     flop_table = level_flop_table(cfg)
+    # wire account (ISSUE 7): the dense bytes-on-the-wire per fused round
+    # from the analytic byte table (the SAME table the staticcheck wire
+    # budget enforces by equality against the traced psum operands) -- the
+    # recorded dense baseline the compressed-aggregation frontier lands
+    # against.  Both strategies' fused rounds join ONE global reduction of
+    # the level-a footprint (sums + count masks, f32); the per-level rows
+    # are the sliced payloads of the grouped engine's K=1 per-level psums.
+    from heterofl_tpu.fed.core import level_byte_table
+    from heterofl_tpu.staticcheck.wire import dense_round_wire
+
+    byte_table = level_byte_table(cfg)
+    top_rate = max(byte_table)
+    wire_extra = {
+        "source": "fed.core.level_byte_table",
+        "unit": "bytes/round",
+        "per_level_wire_bytes": {f"{r:g}": v["wire_bytes"]
+                                 for r, v in sorted(byte_table.items(),
+                                                    reverse=True)},
+        "strategies": {
+            s: dense_round_wire(byte_table[top_rate]["param_bytes"],
+                                mesh.shape["clients"])
+            for s in ("masked", "grouped")},
+    }
     shard_n = store.shard_max if population else x.shape[1]
     local_steps = cfg["num_epochs"]["local"] * int(
         np.ceil(shard_n / cfg["batch_size"]["train"]))
@@ -785,6 +819,7 @@ def main():
                       "n_train": n_train, "final_loss": round(loss, 4),
                       "strategy": strategy,
                       "mfu": mfu_extra(rps),
+                      "wire": wire_extra,
                       "compile_cache": {
                           "enabled": bool(cache_dir),
                           "requests": cache_counters["requests"],

@@ -217,6 +217,55 @@ def level_flop_table(cfg: Dict[str, Any], rates: Optional[list] = None
     return out
 
 
+#: bytes per parameter element on the wire and in HBM: params, update sums
+#: and count masks are all float32 (compute_dtype only narrows activations)
+PARAM_ITEMSIZE = 4
+
+
+def level_param_table(cfg: Dict[str, Any], rates: Optional[list] = None
+                      ) -> Dict[float, int]:
+    """Analytic per-level parameter COUNTS of the sliced sub-model at each
+    rate of the level table (a count view over :func:`level_byte_table`,
+    which owns the per-module accounting; the counts match ``model.init``
+    trees exactly, which the staticcheck wire audit relies on)."""
+    return {r: v["param_bytes"] // PARAM_ITEMSIZE
+            for r, v in level_byte_table(cfg, rates).items()}
+
+
+def level_byte_table(cfg: Dict[str, Any], rates: Optional[list] = None,
+                     itemsize: int = PARAM_ITEMSIZE) -> Dict[float, Dict[str, int]]:
+    """Analytic per-level byte/shape table (ISSUE 7): for each rate level,
+
+    * ``param_bytes`` -- the sliced sub-model's parameter footprint;
+    * ``wire_bytes`` -- the dense per-round reduction payload of that
+      level's round program: ``sum(param_bytes) + count_bytes`` (the
+      counted-average aggregation psums the update sums AND the
+      element-count masks, both param-shaped f32, in ONE bind);
+    * ``activation_bytes`` -- per-local-step forward activation output
+      bytes at the training batch size (``module_table`` output sizes x
+      f32), the per-client working-set term of the HBM budget.
+
+    The wire numbers are exact for the audited programs (verified against
+    traced psum operand avals), which is what lets staticcheck enforce the
+    wire budget by equality rather than tolerance."""
+    from ..analysis.summary import module_table
+
+    grate = cfg["global_model_rate"]
+    if rates is None:
+        rates = sorted({float(r) for r in cfg["model_rate"]}, reverse=True)
+    out: Dict[float, Dict[str, int]] = {}
+    for r in rates:
+        rows = module_table(cfg, float(r) / grate)
+        nparam = int(sum(row[3] for row in rows))
+        act = int(sum(int(np.prod(row[2])) for row in rows))
+        out[float(r)] = {
+            "param_bytes": nparam * itemsize,
+            "wire_bytes": 2 * nparam * itemsize,
+            "activation_bytes": act * itemsize,
+        }
+    return out
+
+
 def level_flop_shares(cfg: Dict[str, Any],
                       weights: Optional[Dict[float, float]] = None,
                       rates: Optional[list] = None) -> Dict[float, float]:

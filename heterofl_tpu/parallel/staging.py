@@ -122,8 +122,8 @@ class PlacementCache:
         leak device buffers -- for the experiment's lifetime."""
         slot = (srange, np.dtype(dtype).name)
         hit = self._scalars.get(slot)
-        # staticcheck: allow(no-float-coercion, no-asarray): THE blessed
-        # scalar staging path -- host value compare + one explicit put
+        # staticcheck: allow(no-float-coercion): THE blessed scalar staging
+        # path -- host value compare + one explicit put
         if hit is None or hit[0] != float(value):
             arr = jax.device_put(np.asarray(value, dtype),  # staticcheck: allow(no-asarray): explicit staging put
                                  NamedSharding(self.mesh_for(srange), P()))
@@ -340,13 +340,11 @@ class MetricsPipeline:
 # ---------------------------------------------------------------------------
 
 def _idx64(a) -> np.ndarray:
-    """Host index/label-metadata normalization for the ClientStore.
-
-    # staticcheck: allow(no-asarray): host int64 METADATA coercion -- never
-    # wraps a device array; cohort bytes reach the mesh only through the
-    # CohortStager's explicit device_put.
-    """
-    return np.asarray(a, np.int64)  # staticcheck: allow(no-asarray): see docstring
+    """Host index/label-metadata normalization for the ClientStore: a host
+    int64 coercion that never wraps a device array -- cohort bytes reach the
+    mesh only through the CohortStager's explicit device_put (hence the
+    inline allow below)."""
+    return np.asarray(a, np.int64)  # staticcheck: allow(no-asarray): host metadata only
 
 
 class ClientStore:

@@ -14,9 +14,17 @@ Two fronts, one gate (ISSUE 3):
   donated leaf consumed by input-output aliasing, donation warnings
   promoted to failures), the collectives budget (exactly ONE global psum
   per fused round, axes resolvable in the mesh), recompile-hazard freedom
-  (fresh-but-identical host inputs leave the program cache untouched), and
-  the FLOP budget (``cost_analysis()`` per level vs the analytic shares
-  from :func:`~..fed.core.level_flop_shares`).
+  (fresh-but-identical host inputs leave the program cache untouched), the
+  FLOP budget (``cost_analysis()`` per level vs the analytic shares from
+  :func:`~..fed.core.level_flop_shares`), and the ISSUE 7 passes: the
+  bytes-on-the-wire budget (:mod:`.wire`, enforced by equality against
+  ``fed.core.level_byte_table``), the HBM footprint budget
+  (:mod:`.memory`), and the reshard detector (zero data-movement
+  collectives, jaxpr and optimized-HLO halves).
+* :mod:`.ratchet` -- every audited metric diffed against the committed
+  ``STATICCHECK_BASELINE.json`` with per-metric tolerances
+  (``--diff-baseline`` exits 2 on regression; ``--update-baseline``
+  re-pins after an intentional change).
 
 CLI: ``python -m heterofl_tpu.staticcheck --json`` (exits non-zero on any
 finding; writes the ``STATICCHECK.json`` artifact ``bench.py`` folds into

@@ -61,7 +61,24 @@ def main(argv=None) -> int:
                         help="artifact path (default: <repo>/STATICCHECK.json)")
     parser.add_argument("--no-artifact", action="store_true",
                         help="do not write the artifact file")
+    parser.add_argument("--baseline", default=None,
+                        help="ratchet baseline path (default: "
+                             "<repo>/STATICCHECK_BASELINE.json)")
+    parser.add_argument("--diff-baseline", action="store_true",
+                        help="diff the fresh audit against the committed "
+                             "baseline; exit 2 on any ratchet regression "
+                             "(audit/lint failures still exit 1)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="re-pin the baseline from this (green) audit "
+                             "after an intentional metric change")
     args = parser.parse_args(argv)
+    if args.baseline is None:
+        from .ratchet import BASELINE_BASENAME
+
+        args.baseline = os.path.join(_REPO, BASELINE_BASENAME)
+    if (args.diff_baseline or args.update_baseline) and args.skip_audit:
+        parser.error("--diff-baseline/--update-baseline need the program "
+                     "audit (drop --skip-audit)")
 
     from .report import AuditReport
     from .rules import lint_tree
@@ -88,22 +105,66 @@ def main(argv=None) -> int:
     report.config["skipped"] = {"audit": args.skip_audit,
                                 "lint": args.skip_lint}
 
+    # baseline ratchet (ISSUE 7): the analytic budgets are ceilings, the
+    # committed baseline is the tight line -- diff before the artifact is
+    # written so STATICCHECK.json carries the ratchet section
+    from .ratchet import diff_reports, load_baseline, write_baseline
+
+    if args.diff_baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            report.ratchet = {
+                "checked": True, "ok": False,
+                "regressions": [{"program": "<baseline>", "metric": "load",
+                                 "baseline": None, "current": None,
+                                 "tolerance": 0.0,
+                                 "message": f"cannot load baseline "
+                                            f"{args.baseline}: {e} -- run "
+                                            f"--update-baseline on a green "
+                                            f"tree and commit the file"}],
+                "improvements": [], "new_programs": [],
+                "missing_programs": []}
+        else:
+            report.ratchet = diff_reports(report.to_dict(), baseline)
+    if args.update_baseline:
+        if not report.ok:
+            # refuse the pin but fall through: the failing artifact still
+            # gets written and the findings still print, exactly like a
+            # plain failing run
+            print("staticcheck: refusing to pin a baseline from a FAILING "
+                  "audit -- fix the findings first", file=sys.stderr)
+        else:
+            write_baseline(args.baseline, report.to_dict())
+
     if not args.no_artifact:
         with open(args.out, "w") as f:
             f.write(report.to_json())
             f.write("\n")
 
+    ratchet_regressed = report.ratchet.get("checked") \
+        and not report.ratchet.get("ok")
     if args.json:
         print(report.to_json())
     else:
         for f in report.all_findings():
             print(f)
+        for reg in report.ratchet.get("regressions", []):
+            print(f"{reg['program']}: [ratchet:{reg['metric']}] "
+                  f"{reg['baseline']} -> {reg['current']}: {reg['message']}")
         n_prog = len(report.programs)
-        print(f"staticcheck: {'OK' if report.ok else 'FAILED'} -- "
+        verdict = "OK" if report.ok else "FAILED"
+        if report.ok and ratchet_regressed:
+            verdict = "RATCHET REGRESSED"
+        print(f"staticcheck: {verdict} -- "
               f"{n_prog} programs audited, "
-              f"{len(report.all_findings())} finding(s)"
+              f"{len(report.all_findings())} finding(s), "
+              f"{len(report.ratchet.get('regressions', []))} ratchet "
+              f"regression(s)"
               + ("" if args.no_artifact else f"; artifact: {args.out}"))
-    return 0 if report.ok else 1
+    if not report.ok:
+        return 1
+    return 2 if ratchet_regressed else 0
 
 
 if __name__ == "__main__":

@@ -128,6 +128,79 @@ def count_psum_over(jaxpr, axis: str = "clients") -> int:
     return n
 
 
+def collective_payload_rows(jaxpr) -> List[dict]:
+    """One priced row per collective bind: primitive, sorted axis names,
+    per-participant payload bytes (sum of operand aval bytes -- under
+    ``shard_map`` the operands are per-device values, so this is exactly
+    what each participant contributes to the wire), operand shapes/dtypes,
+    and provenance.  The wire model (:mod:`.wire`) turns these into
+    ICI/DCN-classified budgets."""
+    import numpy as np
+
+    rows = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if not any(name == p or name.startswith(p + "_")
+                   for p in COLLECTIVE_PRIMITIVES):
+            continue
+        payload = 0
+        operands = []
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is None:
+                continue
+            try:
+                nbytes = int(np.prod(aval.shape)) * np.dtype(dt).itemsize
+            except TypeError:  # extended dtypes (PRNG keys) have no itemsize
+                continue
+            payload += nbytes
+            operands.append([list(map(int, aval.shape)), str(dt)])
+        rows.append({"primitive": name, "axes": sorted(collective_axes(eqn)),
+                     "payload_bytes": payload, "operands": operands,
+                     "provenance": provenance(eqn)})
+    return rows
+
+
+#: jaxpr-level primitives that MOVE data between devices without reducing
+#: it -- explicit reshards; zero are allowed in any round program
+RESHARD_PRIMITIVES = ("all_to_all", "ppermute")
+
+#: optimized-HLO instruction ops GSPMD inserts to fix up sharding
+#: mismatches -- implicit reshards the jaxpr never shows; zero allowed
+RESHARD_HLO_OPS = ("all-to-all", "collective-permute")
+
+
+def find_reshards(jaxpr) -> List[Tuple[str, str]]:
+    """(primitive, provenance) of every explicit data-movement collective
+    bound in the program (jaxpr level)."""
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if any(name == p or name.startswith(p + "_")
+               for p in RESHARD_PRIMITIVES):
+            out.append((name, provenance(eqn)))
+    return out
+
+
+def reshard_ops(compiled_text: str) -> dict:
+    """Counts of GSPMD-introduced data-movement instructions in an
+    optimized-HLO dump: ``all-to-all`` and ``collective-permute`` (their
+    async ``-start`` forms count once; ``-done`` halves are skipped).
+    These appear when sharding propagation decides operands live on the
+    wrong devices -- data movement the jaxpr walk cannot see, and exactly
+    what the multi-host slices work must keep at zero."""
+    out = {}
+    for op in RESHARD_HLO_OPS:
+        # `= <shape> op(`: the shape may be a tuple (async -start forms), so
+        # allow anything shape-like between `=` and the op name; `[^=]`
+        # keeps the match from crossing into metadata/attribute text
+        out[op] = len(re.findall(
+            rf"=[ ]*[^=\n]*?\b{re.escape(op)}(?:-start)?\(", compiled_text))
+    out["total"] = sum(out.values())
+    return out
+
+
 def count_psum_joint(jaxpr, axes: Tuple[str, ...] = ("clients", "data")) -> int:
     """psum binds whose axis set includes ALL of ``axes`` -- the eval
     phase's whole-mesh reductions (sBN moments, Global metric sums) reduce

@@ -48,6 +48,15 @@ class ProgramReport:
     donation_expected: int = 0
     flops: Optional[float] = None
     memory: Optional[Dict[str, int]] = None
+    #: analytic HBM bound the memory fields were held to, plus the
+    #: donation-savings accounting (ISSUE 7: staticcheck/memory.py)
+    memory_budget: Optional[Dict[str, Any]] = None
+    #: per-collective bytes-on-the-wire table + train/eval/DCN totals
+    #: (ISSUE 7: staticcheck/wire.py)
+    wire: Optional[Dict[str, Any]] = None
+    #: explicit (jaxpr) + GSPMD-introduced (optimized HLO) reshard op
+    #: counts; zero allowed (ISSUE 7 reshard detector)
+    reshards: Optional[Dict[str, Any]] = None
     #: optimized-HLO kernel stats of the program's scan body (the local-step
     #: loop): fusion launches + instruction count per iteration, and the
     #: budget enforced against it (None = recorded, not budgeted)
@@ -70,6 +79,11 @@ class AuditReport:
     flop_budget: Dict[str, Any] = field(default_factory=dict)
     recompile: Dict[str, Any] = field(default_factory=dict)
     lint: List[Finding] = field(default_factory=list)
+    #: baseline-ratchet diff (ISSUE 7: staticcheck/ratchet.py).  ``checked``
+    #: is False unless the CLI ran ``--diff-baseline``; a regressed ratchet
+    #: keeps ``ok`` True (the audit itself is green) but exits 2 and makes
+    #: bench.py refuse to record.
+    ratchet: Dict[str, Any] = field(default_factory=lambda: {"checked": False})
     generated_at: Optional[str] = None
 
     def add_program(self, prog: ProgramReport) -> None:
@@ -98,13 +112,14 @@ class AuditReport:
 
     def to_dict(self) -> Dict[str, Any]:
         return {
-            "version": 1,
+            "version": 2,  # 2: + per-program wire/memory/reshards, ratchet
             "ok": self.ok,
             "generated_at": self.generated_at,
             "config": self.config,
             "programs": {k: asdict(v) for k, v in self.programs.items()},
             "flop_budget": self.flop_budget,
             "recompile": self.recompile,
+            "ratchet": self.ratchet,
             "lint": [asdict(f) for f in self.lint],
         }
 

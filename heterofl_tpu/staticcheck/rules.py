@@ -131,14 +131,28 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
 )
 
 
-def _collect_pragmas(src: str) -> Dict[int, Set[str]]:
-    """line number -> set of allowed rule ids.
+@dataclass(frozen=True)
+class PragmaEntry:
+    """One ``# staticcheck: allow(...)`` occurrence: where it sits, which
+    rule ids it licenses, and which source lines it covers.  The stale
+    check walks these -- a pragma none of whose covered lines suppressed a
+    finding for a licensed rule is dead weight."""
+
+    line: int
+    ids: Tuple[str, ...]
+    covered: Tuple[int, ...]
+
+
+def _collect_pragmas(src: str) -> Tuple[Dict[int, Set[str]],
+                                        List[PragmaEntry]]:
+    """(line number -> allowed rule ids, pragma occurrences).
 
     A pragma covers its own line; a pragma inside a standalone comment
     block also covers the statement line the block precedes (so a
     multi-line reason can sit above the call it licenses)."""
     lines = src.splitlines()
     out: Dict[int, Set[str]] = {}
+    entries: List[PragmaEntry] = []
 
     def add(i: int, ids: Set[str]) -> None:
         out.setdefault(i, set()).update(ids)
@@ -148,15 +162,19 @@ def _collect_pragmas(src: str) -> Dict[int, Set[str]]:
         if not m:
             continue
         ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+        covered = [i]
         add(i, ids)
         if line.lstrip().startswith("#"):
             j = i + 1
             while j <= len(lines) and lines[j - 1].lstrip().startswith("#"):
                 add(j, ids)
+                covered.append(j)
                 j += 1
             if j <= len(lines):
                 add(j, ids)
-    return out
+                covered.append(j)
+        entries.append(PragmaEntry(i, tuple(sorted(ids)), tuple(covered)))
+    return out, entries
 
 
 def _alias_map(tree: ast.AST) -> Dict[str, str]:
@@ -197,26 +215,33 @@ def _node_lines(node: ast.AST) -> Iterable[int]:
     return range(lo, hi + 1)
 
 
-def _suppressed(node: ast.AST, rule_id: str, pragmas: Dict[int, Set[str]]) -> bool:
-    return any(rule_id in pragmas.get(ln, ()) for ln in _node_lines(node))
-
-
 def lint_source(src: str, relpath: str,
                 rules: Sequence[Rule] = DEFAULT_RULES) -> List[Finding]:
-    """Lint one file's source.  ``relpath`` decides which rules apply."""
+    """Lint one file's source.  ``relpath`` decides which rules apply.
+
+    Besides the banned-call findings, every ``allow(<rule>)`` pragma is
+    audited for liveness: a pragma whose rule no longer fires on any line
+    it covers (or that names an unknown rule, or a rule not scoped to this
+    path) is a ``stale-pragma`` finding -- dead pragmas otherwise rot
+    silently and mask the next real violation on their line."""
     active = [r for r in rules if r.applies_to(relpath)]
-    if not active:
-        return []
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
         return [Finding("syntax-error", f"{relpath}:{e.lineno or 0}", str(e))]
     aliases = _alias_map(tree)
-    pragmas = _collect_pragmas(src)
+    pragmas, pragma_entries = _collect_pragmas(src)
+    if not active and not pragma_entries:
+        return []
     findings: List[Finding] = []
+    #: (rule id, covered line) pairs that actually suppressed a finding
+    used_pragmas: Set[Tuple[str, int]] = set()
 
     def report(rule: Rule, node: ast.AST, what: str) -> None:
-        if _suppressed(node, rule.id, pragmas):
+        hit = [ln for ln in _node_lines(node)
+               if rule.id in pragmas.get(ln, ())]
+        if hit:
+            used_pragmas.update((rule.id, ln) for ln in hit)
             return
         findings.append(Finding(
             rule.id, f"{relpath}:{node.lineno}",
@@ -283,6 +308,32 @@ def lint_source(src: str, relpath: str,
                             and not isinstance(dec, ast.Call):
                         report(rule, dec, f"bare @{qn} decorator without "
                                f"{'/'.join(rule.require_kwargs)}")
+
+    # stale-pragma audit (ISSUE 7 satellite): every allow(<rule>) occurrence
+    # must have actually suppressed a finding this pass -- per licensed rule
+    # id, so a multi-id pragma reports only its dead halves
+    known_ids = {r.id for r in rules}
+    active_ids = {r.id for r in active}
+    for ent in pragma_entries:
+        for rid in ent.ids:
+            if rid not in known_ids:
+                findings.append(Finding(
+                    "stale-pragma", f"{relpath}:{ent.line}",
+                    f"allow({rid}) names an unknown rule id; known ids: "
+                    f"{sorted(known_ids)}"))
+            elif rid not in active_ids:
+                findings.append(Finding(
+                    "stale-pragma", f"{relpath}:{ent.line}",
+                    f"allow({rid}) licenses a rule that is not scoped to "
+                    f"this path -- the pragma can never suppress anything "
+                    f"here; remove it"))
+            elif not any((rid, ln) in used_pragmas for ln in ent.covered):
+                findings.append(Finding(
+                    "stale-pragma", f"{relpath}:{ent.line}",
+                    f"allow({rid}) no longer suppresses any `{rid}` finding "
+                    f"on the lines it covers; the violation it licensed is "
+                    f"gone -- remove the dead pragma before it masks the "
+                    f"next real one"))
     return findings
 
 

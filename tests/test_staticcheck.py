@@ -302,9 +302,57 @@ def test_flop_budget_and_artifact_roundtrip(audit_report):
         assert meas[f"{hi:g}"] > meas[f"{lo:g}"]
     # the artifact serialises and carries per-program memory bytes
     rec = json.loads(audit_report.to_json())
-    assert rec["ok"] is True and rec["version"] == 1
+    assert rec["ok"] is True and rec["version"] == 2
     mem = rec["programs"]["masked/replicated/k1"]["memory"]
     assert mem and mem["temp_size_in_bytes"] > 0
+
+
+def test_wire_memory_reshard_sections_on_every_program(audit_report):
+    """ISSUE 7 acceptance: STATICCHECK.json grows wire/memory/reshards
+    sections for every audited program variant, and the wire budget of
+    every fused training round equals ONE dense global reduction of the
+    level-a parameter footprint (sums + count masks, f32)."""
+    from heterofl_tpu.fed.core import level_byte_table
+    from heterofl_tpu.staticcheck.audit import default_audit_cfg
+
+    bt = level_byte_table(default_audit_cfg())
+    level_a_wire = bt[max(bt)]["wire_bytes"]
+    assert level_a_wire == 2 * bt[max(bt)]["param_bytes"]
+    for name, p in audit_report.programs.items():
+        assert p.wire is not None, name
+        assert p.memory is not None, name
+        assert p.reshards is not None and p.reshards["total"] == 0, name
+        assert p.wire["dcn_bytes"] == 0, name  # single-slice audit mesh
+        if name == "grouped/span/combine":
+            assert p.wire["train_bytes_per_round"] == 0
+        elif "/level-" in name:  # per-level partial: that level's slice
+            rate = float(name.split("level-")[1].split("/")[0])
+            assert p.wire["train_bytes_per_round"] == bt[rate]["wire_bytes"], name
+        else:  # every fused training round: the dense level-a reduction
+            assert p.wire["train_bytes_per_round"] == level_a_wire, name
+
+
+def test_ratchet_roundtrip_against_fresh_audit(audit_report):
+    """Pinning a baseline from an audit and diffing the same audit against
+    it is clean (the --update-baseline / --diff-baseline round-trip), and
+    the ratchet only tightens: a doctored baseline below the measured
+    metrics regresses the diff."""
+    import copy
+
+    from heterofl_tpu.staticcheck.ratchet import baseline_view, diff_reports
+
+    rec = audit_report.to_dict()
+    base = baseline_view(rec)
+    diff = diff_reports(rec, base)
+    assert diff["ok"], diff["regressions"]
+    assert not diff["regressions"] and not diff["missing_programs"]
+
+    doctored = copy.deepcopy(base)
+    doctored["programs"]["masked/replicated/k1"]["wire.train_bytes_per_round"] -= 4
+    diff = diff_reports(rec, doctored)
+    assert not diff["ok"]
+    assert any(r["metric"] == "wire.train_bytes_per_round"
+               for r in diff["regressions"])
 
 
 def test_auditor_flags_smuggled_io_callback(monkeypatch):
@@ -423,6 +471,41 @@ def test_bench_refuses_failing_audit_artifact():
         assert rec["value"] == 0.0 and rec["vs_baseline"] is None
         assert "refusing" in rec["extra"]["error"]
         assert rec["extra"]["staticcheck"]["ok"] is False
+    finally:
+        if saved is None:
+            os.remove(path)
+        else:
+            with open(path, "w") as f:
+                f.write(saved)
+
+
+def test_bench_refuses_regressed_ratchet_artifact():
+    """ISSUE 7: a GREEN audit whose baseline ratchet regressed must block
+    bench recording the same way a failing audit does."""
+    path = os.path.join(REPO, "STATICCHECK.json")
+    saved = None
+    if os.path.exists(path):
+        with open(path) as f:
+            saved = f.read()
+    try:
+        with open(path, "w") as f:
+            json.dump({"ok": True, "programs": {}, "lint": [],
+                       "ratchet": {"checked": True, "ok": False,
+                                   "regressions": [{"program": "p",
+                                                    "metric": "flops",
+                                                    "baseline": 1,
+                                                    "current": 2,
+                                                    "tolerance": 0.0,
+                                                    "message": "grew"}]}}, f)
+        env = dict(os.environ, BENCH_CPU="1")
+        res = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                             env=env, capture_output=True, text=True,
+                             timeout=300, cwd=REPO)
+        rec = json.loads(res.stdout.strip().splitlines()[-1])
+        assert rec["value"] == 0.0 and rec["vs_baseline"] is None
+        assert "ratchet" in rec["extra"]["error"]
+        assert rec["extra"]["staticcheck"]["ratchet_ok"] is False
+        assert rec["extra"]["staticcheck"]["ratchet_regressions"] == 1
     finally:
         if saved is None:
             os.remove(path)
