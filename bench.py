@@ -22,7 +22,12 @@ BENCH_STRATEGY=masked|grouped (primary engine), BENCH_SUPERSTEP=K to fuse K
 rounds per compiled dispatch (train_superstep; phases amortize per round),
 BENCH_BOTH=0/1 to disable/force the second-strategy record in
 extra.strategies (default: on except budget-constrained fallbacks),
-BENCH_FETCH_EVERY=K to batch the D2H metric fetch, BENCH_EVAL_INTERVAL=E to
+BENCH_WIRE_CODEC=dense|int8|signsgd|topk (ISSUE 8) to compress the
+aggregation payload inside the fused superstep (extra.wire then records the
+measured compressed bytes/round and ratio_vs_dense next to the analytic
+per-codec frontier, all from fed.core.level_codec_byte_table -- the same
+table staticcheck budgets by equality), BENCH_FETCH_EVERY=K to batch the
+D2H metric fetch, BENCH_EVAL_INTERVAL=E to
 run the sBN+eval cadence every E rounds -- the primary record then uses the
 EVAL-FUSED superstep (eval inside the compiled scan, ISSUE 4) and
 extra.strategies carries `<engine>+eval-fused` vs `<engine>+eval-host`
@@ -475,6 +480,29 @@ def main():
     # on-device A/B for the ~3.9x FLOP reduction (MEASUREMENTS.md roofline)
     strategy = os.environ.get("BENCH_STRATEGY", "masked")
     rates_vec = np.asarray(cfg["model_rate"], np.float32)
+    # BENCH_WIRE_CODEC (ISSUE 8): compress the aggregation payload inside
+    # the fused round (heterofl_tpu/compress/).  Lossy codecs need the
+    # fused superstep (the grouped K=1 path has no single global psum), so
+    # a codec without BENCH_SUPERSTEP>1 falls back to dense with a note --
+    # the bench must still print its one JSON line.
+    wire_codec = os.environ.get("BENCH_WIRE_CODEC", "dense") or "dense"
+    try:
+        from heterofl_tpu.compress import resolve_codec_cfg
+
+        wire_codec, _ = resolve_codec_cfg({"wire_codec": wire_codec})
+    except ValueError as e:
+        print(f"bench: ignoring BENCH_WIRE_CODEC: {e}", file=sys.stderr)
+        wire_codec = "dense"
+    try:
+        _superstep_env = int(os.environ.get("BENCH_SUPERSTEP") or 1)
+    except ValueError:
+        _superstep_env = 1  # env_int warns + defaults later; keep its rule
+    if wire_codec != "dense" and _superstep_env <= 1:
+        print(f"bench: BENCH_WIRE_CODEC={wire_codec} needs BENCH_SUPERSTEP>1 "
+              f"(compression lives in the fused superstep); falling back to "
+              f"dense", file=sys.stderr)
+        wire_codec = "dense"
+    cfg["wire_codec"] = wire_codec
 
     def make_engine(strat, cfg_over=None):
         c = cfg if not cfg_over else dict(cfg, **cfg_over)
@@ -514,21 +542,39 @@ def main():
     # against.  Both strategies' fused rounds join ONE global reduction of
     # the level-a footprint (sums + count masks, f32); the per-level rows
     # are the sliced payloads of the grouped engine's K=1 per-level psums.
-    from heterofl_tpu.fed.core import level_byte_table
-    from heterofl_tpu.staticcheck.wire import dense_round_wire
+    from heterofl_tpu.compress import LOSSY_CODECS
+    from heterofl_tpu.fed.core import level_byte_table, level_codec_byte_table
+    from heterofl_tpu.staticcheck.wire import codec_round_wire, dense_round_wire
 
     byte_table = level_byte_table(cfg)
     top_rate = max(byte_table)
+    dense_payload = byte_table[top_rate]["wire_bytes"]
+    # per-codec compressed bytes/round from the SAME table staticcheck
+    # budgets by equality against the traced psum operand avals (ISSUE 8:
+    # no second bytes formula); `codecs` is the analytic frontier, the
+    # per-strategy rows record what THIS run's engines actually moved
+    # (both strategies' fused rounds reduce at the level-a footprint)
+    n_dev_wire = mesh.shape["clients"]
+    codec_bytes = {c: level_codec_byte_table(cfg, c, n_leaves=len(params))[top_rate]
+                   for c in LOSSY_CODECS}
+
+    def strategy_wire():
+        if wire_codec == "dense":
+            return dense_round_wire(byte_table[top_rate]["param_bytes"],
+                                    n_dev_wire)
+        return codec_round_wire(wire_codec, codec_bytes[wire_codec],
+                                dense_payload, n_dev_wire)
+
     wire_extra = {
-        "source": "fed.core.level_byte_table",
+        "source": "fed.core.level_byte_table + level_codec_byte_table",
         "unit": "bytes/round",
+        "codec": wire_codec,
         "per_level_wire_bytes": {f"{r:g}": v["wire_bytes"]
                                  for r, v in sorted(byte_table.items(),
                                                     reverse=True)},
-        "strategies": {
-            s: dense_round_wire(byte_table[top_rate]["param_bytes"],
-                                mesh.shape["clients"])
-            for s in ("masked", "grouped")},
+        "codecs": {c: codec_round_wire(c, b, dense_payload, n_dev_wire)
+                   for c, b in sorted(codec_bytes.items())},
+        "strategies": {s: strategy_wire() for s in ("masked", "grouped")},
     }
     shard_n = store.shard_max if population else x.shape[1]
     local_steps = cfg["num_epochs"]["local"] * int(

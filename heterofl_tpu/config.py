@@ -177,6 +177,31 @@ DEFAULT_CFG: Dict[str, Any] = {
     # SYNCHRONOUS staging -- the loud fallback for samplers whose next
     # cohort depends on round-N outputs (the driver warns once).
     "stream_prefetch": True,
+    # streaming prefetch depth (ISSUE 8 satellite): how many upcoming
+    # supersteps' cohorts may be staged ahead of the in-flight one.  The
+    # CohortStager ring holds depth+1 slots and fences each slot on its
+    # previous private copy, so deeper pipelines stay corruption-safe; 1 =
+    # the PR 6 double buffer.  Depth > 1 pays off once per-superstep
+    # compute shrinks below the host gather time (real-TPU regime).
+    "stream_prefetch_depth": 1,
+    # wire codec (ISSUE 8, heterofl_tpu/compress/): compress the client
+    # update INSIDE the fused round -- quantise -> ONE global psum ->
+    # dequantise, preserving the one-global-psum invariant.  "dense"
+    # (default) keeps today's f32 aggregation bit for bit; "int8" =
+    # per-leaf stochastic-rounding quantisation with int32 lane-packed
+    # accumulation (25% of dense bytes); "signsgd" = 1-bit signs with a
+    # per-leaf scale (~19%); "topk" = rotating-block sparsification riding
+    # the flat width-mask layout (25%).  Lossy codecs carry an
+    # error-feedback residual in the scan state (donated, checkpointed),
+    # have explicit tolerance contracts instead of the dense bitwise ones
+    # (tests/test_compress.py), and need the fused superstep on the
+    # grouped/sliced strategies.
+    "wire_codec": "dense",
+    # error feedback (ISSUE 8): re-inject each round's compression error
+    # into the next round's payload (the residual carry).  True (default)
+    # is the convergence-preserving setting; False drops the error -- the
+    # A/B the convergence contract test pins.  Ignored by "dense".
+    "error_feedback": True,
     "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
     # Applied LAST by process_control: per-key overrides of any derived field
@@ -376,7 +401,29 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
             cfg[k] = {**cfg[k], **v}
         else:
             cfg[k] = v
+    # stale-config lint (ISSUE 8 satellite): unknown wire_codec /
+    # error_feedback values fail HERE, at config validation, with the PR 6
+    # loud-ValueError convention -- never as a silent dense fallback mid-run
+    from .compress import resolve_codec_cfg
+
+    resolve_codec_cfg(cfg)
+    resolve_prefetch_depth(cfg)
     return cfg
+
+
+def resolve_prefetch_depth(cfg: Dict[str, Any]) -> int:
+    """Validate ``cfg['stream_prefetch_depth']`` and return it (ISSUE 8
+    satellite).  THE one validator: process_control applies it, and the
+    engines/driver (often built directly from a cfg dict, bypassing
+    process_control) re-apply it rather than coercing bad values to the
+    default."""
+    depth = cfg.get("stream_prefetch_depth", 1)
+    if depth is None:
+        return 1
+    if not isinstance(depth, int) or isinstance(depth, bool) or depth < 1:
+        raise ValueError(f"Not valid stream_prefetch_depth: {depth!r} "
+                         f"(an int >= 1)")
+    return depth
 
 
 def ceil_width(size: int, rate: float) -> int:

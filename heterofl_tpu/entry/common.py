@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config as C
+from ..compress import resolve_codec_cfg
 from ..data import (
     bptt_windows,
     stack_windows,
@@ -211,13 +212,36 @@ class FedExperiment:
         self.streaming = store_mode == "stream"
         self.stream_prefetch = bool(cfg.get("stream_prefetch", True))
         self.store: Optional[ClientStore] = None
-        self._next_cohort = None  # (epoch0, k, StagedCohort) prefetched
+        # prefetched (epoch0, k, StagedCohort) queue, up to
+        # cfg['stream_prefetch_depth'] supersteps ahead (ISSUE 8 satellite)
+        self._next_cohorts: List[Tuple[int, int, Any]] = []
+        self._prefetch_depth = C.resolve_prefetch_depth(cfg)
         self._stream_sync_warned = False
         if self.streaming and cfg.get("strategy") == "sliced":
             raise ValueError(
                 "client_store='stream' needs a mesh-native strategy "
                 "('masked' or 'grouped'): the cohort pipeline stages "
                 "through the engines' superstep programs")
+        # wire codec (ISSUE 8): validated loudly here so a typo'd codec
+        # never runs a silently-dense experiment; the lossy codecs need the
+        # engines' single-global-psum programs
+        self.wire_codec, self.error_feedback = resolve_codec_cfg(cfg)
+        if self.wire_codec != "dense":
+            if cfg.get("strategy") == "sliced":
+                raise ValueError(
+                    f"wire_codec={self.wire_codec!r} needs a mesh-native "
+                    f"strategy ('masked' or 'grouped'): the sliced debug "
+                    f"twin aggregates on the host, there is no psum to "
+                    f"compress")
+            if cfg.get("strategy") == "grouped" \
+                    and int(cfg.get("superstep_rounds", 1) or 1) <= 1 \
+                    and store_mode != "stream":
+                raise ValueError(
+                    f"wire_codec={self.wire_codec!r} with the grouped "
+                    f"strategy needs the fused superstep (superstep_rounds "
+                    f"> 1 or client_store='stream'): the K=1 "
+                    f"host-orchestrated path reduces per level and has no "
+                    f"single global psum to compress")
         # fused multi-round superstep (ISSUE 2) with the sBN+eval phase
         # folded into the scan (ISSUE 4): K rounds per compiled program,
         # eval windows no longer clamp K.  Most knob combinations are now
@@ -481,9 +505,9 @@ class FedExperiment:
         (first superstep of a run; ``stream_prefetch`` off -- warned once:
         a sampler that depends on round-N outputs cannot prefetch, and the
         staging then serialises with compute)."""
-        nxt, self._next_cohort = self._next_cohort, None
-        if nxt is not None and nxt[0] == epoch0 and nxt[1] == k:
-            return nxt[2]
+        if self._next_cohorts and self._next_cohorts[0][:2] == (epoch0, k):
+            return self._next_cohorts.pop(0)[2]
+        self._next_cohorts = []  # a schedule jump invalidates the queue
         if not self.stream_prefetch and not self._stream_sync_warned:
             self._stream_sync_warned = True
             warnings.warn(
@@ -493,16 +517,28 @@ class FedExperiment:
         return self._stage_cohort(epoch0, k)
 
     def _prefetch_cohort(self, epoch0: int):
-        """Stage the NEXT superstep's cohort right after this superstep
+        """Stage UPCOMING supersteps' cohorts right after this superstep
         dispatched: the device_put pipeline overlaps with the in-flight
-        scanned program (depth-1 double buffering)."""
+        scanned program.  ``stream_prefetch_depth`` (ISSUE 8 satellite)
+        bounds how many supersteps ahead the queue runs; the stager's ring
+        holds depth+1 slots and fences each slot on its previous private
+        copy, so staging ahead can never corrupt an in-flight superstep."""
         if not self.stream_prefetch:
             return
         n_rounds = self.cfg["num_epochs"]["global"]
-        if epoch0 > n_rounds:
-            return
-        k = min(self.superstep_rounds, n_rounds - epoch0 + 1)
-        self._next_cohort = (epoch0, k, self._stage_cohort(epoch0, k))
+        e = (self._next_cohorts[-1][0] + self._next_cohorts[-1][1]
+             if self._next_cohorts else epoch0)
+        while len(self._next_cohorts) < self._prefetch_depth \
+                and e <= n_rounds:
+            k = min(self.superstep_rounds, n_rounds - e + 1)
+            self._next_cohorts.append((e, k, self._stage_cohort(e, k)))
+            e += k
+
+    def _codec_engine(self):
+        """The engine holding the wire-codec error-feedback carry (the one
+        that dispatches the compressed programs)."""
+        return self.alt_engine if self.cfg.get("strategy") == "grouped" \
+            else self.engine
 
     def _fused_eval(self):
         """The experiment's :class:`~..parallel.evaluation.FusedEval`: eval
@@ -729,6 +765,12 @@ class FedExperiment:
         pivot = -float("inf") if pivot_mode == "max" else float("inf")
         if blob:
             params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+            if blob.get("wire_resid") is not None:
+                # resume the wire codec's error-feedback carry (ISSUE 8):
+                # without it the first resumed round re-loses the residual a
+                # checkpointed run already accounted for (weights-only
+                # resume_mode=2 intentionally resets it to zeros)
+                self._codec_engine().set_wire_resid(blob["wire_resid"])
             if "epoch" in blob:
                 last_epoch = blob["epoch"]
                 pivot = blob.get("pivot", pivot)
@@ -795,6 +837,10 @@ class FedExperiment:
                 "label_split": label_split,
                 "params": params,
                 "bn_state": getattr(self, "bn_state", {}),
+                # the error-feedback residual carry at this superstep
+                # boundary (ISSUE 8; None under the dense codec)
+                "wire_resid": (self._codec_engine().wire_resid_host()
+                               if self.wire_codec != "dense" else None),
                 "pivot": pivot,
                 "logger_history": dict(logger.history),
                 "logger_state": logger.state_dict(),

@@ -266,6 +266,25 @@ def level_byte_table(cfg: Dict[str, Any], rates: Optional[list] = None,
     return out
 
 
+def level_codec_byte_table(cfg: Dict[str, Any], codec: str,
+                           rates: Optional[list] = None,
+                           n_leaves: int = 0) -> Dict[float, int]:
+    """Analytic per-level COMPRESSED wire bytes of one fused training round
+    under ``codec`` (ISSUE 8): the per-participant psum payload of that
+    level's flat element count, priced by the one formula in
+    :func:`~..compress.codec_payload_bytes`.  THE single source the
+    staticcheck wire budget enforces by equality against the traced psum
+    operand avals AND ``bench.py``'s ``extra.wire`` records -- there is no
+    second bytes formula.  ``n_leaves`` (the param-tree leaf count) only
+    affects the ``signsgd`` scale vector; the fused rounds of both engines
+    reduce at the level-a (global) footprint, so their budget is this
+    table's top-rate entry."""
+    from ..compress import codec_payload_bytes
+
+    return {r: codec_payload_bytes(codec, n, n_leaves)
+            for r, n in level_param_table(cfg, rates).items()}
+
+
 def level_flop_shares(cfg: Dict[str, Any],
                       weights: Optional[Dict[float, float]] = None,
                       rates: Optional[list] = None) -> Dict[float, float]:

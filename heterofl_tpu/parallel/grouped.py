@@ -62,18 +62,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..compress import make_codec, resid_slots, resolve_codec_cfg
+from ..config import resolve_prefetch_depth
 from ..fed.core import (combine_counted, embed_sliced_jnp, extract_sliced_jnp,
                         level_flop_table, snap_to_levels)
 from ..models import make_model
 from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
 from ..utils.optim import make_traced_lr_fn
-from .round_engine import RoundEngine, _bucket_pow2, _ceil_div, _shard_map
+from .round_engine import (RoundEngine, _bucket_pow2, _ceil_div,
+                           _shard_map, _WireCodecCarry)
 from .staging import (ClientStore, CohortStager, PendingMetrics, PhaseTimer,
                       PlacementCache, SlotPacker, StagedCohort)
 
 
-class GroupedRoundEngine:
+class GroupedRoundEngine(_WireCodecCarry):
     """Mesh-native sliced strategy: same public round signature as
     ``fed.sliced.SlicedFederation`` (host-side rates in, per-slot metrics
     out), but every program runs on the mesh and aggregation state never
@@ -118,8 +121,16 @@ class GroupedRoundEngine:
         # dispatch device-resident buffers with zero implicit resharding
         self._staging = PlacementCache(mesh)
         self._packer = SlotPacker()
-        # streaming cohort pipeline (ISSUE 6): built on first stage_cohort
+        # streaming cohort pipeline (ISSUE 6): built on first stage_cohort;
+        # ring depth = cfg['stream_prefetch_depth'] (ISSUE 8 satellite)
         self._cohort_stager = None
+        self._prefetch_depth = resolve_prefetch_depth(cfg)
+        # wire codec (ISSUE 8): compression lives in the fused superstep
+        # (where the ONE global psum is); the K=1 host-orchestrated
+        # per-level path stays dense and train_round refuses lossy codecs
+        self._codec_name, self._error_feedback = resolve_codec_cfg(cfg)
+        self._codec_obj = None
+        self._resid = None
         if self.level_placement == "slices":
             if jax.process_count() > 1:
                 # slice boundaries are not host-aligned yet: a level whose
@@ -329,6 +340,12 @@ class GroupedRoundEngine:
         ``async_metrics=True`` the per-slot metric sums stay on device and a
         :class:`~.staging.PendingMetrics` is returned in their place, so the
         caller can overlap the D2H fetch with the next round's dispatch."""
+        if self._codec_name != "dense":
+            raise ValueError(
+                f"wire_codec={self._codec_name!r} needs the fused grouped "
+                f"superstep (set superstep_rounds > 1 or client_store="
+                f"'stream'): the K=1 host-orchestrated path reduces per "
+                f"level and has no single global psum to compress")
         timer = timer if timer is not None else PhaseTimer()
         n_dev = self.mesh.shape["clients"]
         with timer.phase("stage"):
@@ -491,8 +508,17 @@ class GroupedRoundEngine:
                                    np.int32)
 
         n_data_args = 2 if self.is_lm else 4
+        codec = self._codec_name != "dense"
+        # per-device max contributing clients: the span layout runs every
+        # level's slots on every device, the slices layout one level's --
+        # this bounds the partial-sum magnitude the codec's grid must cover
+        cmax = (len(level_rates) if mode == "span" else 1) * per_dev
 
-        def sbody(params, base_key, epoch0, *rest):
+        def sbody(params, *all_rest):
+            if codec:
+                resid0, base_key, epoch0, *rest = all_rest
+            else:
+                base_key, epoch0, *rest = all_rest
             idx = 0
             if lr_arg:
                 lr_const = rest[0]
@@ -506,7 +532,8 @@ class GroupedRoundEngine:
                 data = rest[idx + 1:idx + 1 + n_data_args]
                 eval_ops = rest[idx + 1 + n_data_args:]
 
-            def step(p, xs):
+            def step(carry, xs):
+                p, rs = carry if codec else (carry, None)
                 if streaming:
                     t, srow, *d = xs
                 else:
@@ -546,26 +573,44 @@ class GroupedRoundEngine:
 
                     tot_s, tot_c, ms = jax.lax.switch(
                         branch, [mk(r) for r in level_rates], p, key, lr, srow)
-                # THE single global psum of the fused round (the PR 2
-                # invariant, audited by staticcheck): one bind joins the
-                # level sums AND counts across the whole clients axis
-                tot_s, tot_c = jax.lax.psum((tot_s, tot_c), "clients")
+                if codec:
+                    # wire codec (ISSUE 8): the SAME single bind carries the
+                    # packed compressed payload of the embedded level
+                    # partials; EF residual re-injected next round
+                    from ..compress.codecs import compressed_psum
+
+                    tot_s, tot_c, nr = compressed_psum(
+                        self._codec(p), "clients", p, tot_s, tot_c, rs, key,
+                        cmax)
+                else:
+                    # THE single global psum of the fused round (the PR 2
+                    # invariant, audited by staticcheck): one bind joins the
+                    # level sums AND counts across the whole clients axis
+                    tot_s, tot_c = jax.lax.psum((tot_s, tot_c), "clients")
                 new_p = combine_counted(p, tot_s, tot_c)
-                return new_p, ms
+                return ((new_p, nr) if codec else new_p), ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
             xs = (epochs, sched) + (tuple(sdata) if streaming else ())
+            carry0 = (params, resid0[0]) if codec else params
             if groups is None:
-                new_params, ms = jax.lax.scan(step, params, xs)
-                return new_params, ms
+                carry, ms = jax.lax.scan(step, carry0, xs)
+                if codec:
+                    return carry[0], carry[1][None], ms
+                return carry, ms
             # eval runs on the combined globals AFTER the round(s) it
             # follows, outside the slices-mode switch; the shared walk keeps
             # it at the program's top level (bit-identical-to-host contract)
-            return eval_fused_scan(step, params, xs, epochs, groups,
-                                   fused_eval, eval_ops)
+            carry, ms, ev = eval_fused_scan(
+                step, carry0, xs, epochs, groups, fused_eval, eval_ops,
+                params_of=(lambda c: c[0]) if codec else None)
+            if codec:
+                return carry[0], carry[1][None], ms, ev
+            return carry, ms, ev
 
         lr_specs = (P(),) if lr_arg else ()
         eval_specs = tuple(fused_eval.specs) if groups else ()
+        resid_specs = (P("clients"),) if codec else ()
         sched_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
         if streaming:
             # cohort stacks ride the xs in the schedule's own slot layout
@@ -573,16 +618,25 @@ class GroupedRoundEngine:
         else:
             data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
         ms_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
-        out_specs = (P(), ms_spec)
+        out_specs = (P(),) + resid_specs + (ms_spec,)
         if groups is not None:
             out_specs = out_specs + (fused_eval.out_specs,)
         fn = _shard_map(
             sbody, mesh,
-            in_specs=(P(), P(), P()) + lr_specs + (sched_spec,) + data_specs
-            + eval_specs,
+            in_specs=(P(),) + resid_specs + (P(), P()) + lr_specs
+            + (sched_spec,) + data_specs + eval_specs,
             out_specs=out_specs,
         )
-        prog = jax.jit(fn, donate_argnums=(0,))
+        # Codec programs donate ONLY the resid carry, not the params carry:
+        # donating the replicated params here trips an XLA:CPU executable-
+        # serialization bug (jaxlib 0.4.36) where the program reloaded from
+        # the persistent compile cache mis-assigns the params-sized resid
+        # OUTPUT buffer and returns nondeterministic garbage on a stable
+        # subset of its elements (fresh compiles are correct; caught by
+        # test_resid_checkpoint_roundtrip_grouped on a warm cache).  Cost:
+        # one extra params-size buffer in lossy-codec grouped supersteps,
+        # priced into the staticcheck HBM budgets.
+        prog = jax.jit(fn, donate_argnums=(1,) if codec else (0,))
         self._superstep_progs[key_] = prog
         return prog
 
@@ -659,7 +713,8 @@ class GroupedRoundEngine:
             shape, per_dev, mode, positions, level_rates = \
                 self._cohort_layout(user_schedule, rate_schedule)
             if self._cohort_stager is None:
-                self._cohort_stager = CohortStager(self.mesh)
+                self._cohort_stager = CohortStager(self.mesh,
+                                                   depth=self._prefetch_depth)
             st = self._cohort_stager
             n = store.shard_max
             if self.is_lm:
@@ -750,6 +805,8 @@ class GroupedRoundEngine:
                 eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
                 epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
                 global_params = self._staging.commit(self._pin(global_params))
+                resid_args = () if self._codec_name == "dense" \
+                    else (self._ensure_resid(global_params),)
                 prog = self._superstep_prog(k, per_dev, mode,
                                             eval_mask=eval_mask,
                                             fused_eval=fused_eval,
@@ -786,13 +843,20 @@ class GroupedRoundEngine:
                 epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
                 # commit the params carry (see train_round), layout pinned
                 global_params = self._staging.commit(self._pin(global_params))
+                resid_args = () if self._codec_name == "dense" \
+                    else (self._ensure_resid(global_params),)
                 prog = self._superstep_prog(k, per_dev, mode,
                                             eval_mask=eval_mask,
                                             fused_eval=fused_eval,
                                             lr_arg=lr_arg)
         with timer.phase("dispatch"):
-            out = prog(global_params, base_key, epoch0_dev, *lr_args,
-                       sched_dev, *args, *eval_args)
+            out = prog(global_params, *resid_args, base_key, epoch0_dev,
+                       *lr_args, sched_dev, *args, *eval_args)
+        if self._codec_name != "dense":
+            # stash the new error-feedback carry (checkpointed via
+            # wire_resid_host / set_wire_resid at superstep boundaries)
+            self._resid = out[1]
+            out = (out[0],) + out[2:]
 
         def _assemble_train(host):
             rounds = []

@@ -35,6 +35,8 @@ except ImportError:  # pragma: no cover
     def _shard_map(f, mesh, in_specs, out_specs):
         return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
+from ..compress import make_codec, resid_slots, resolve_codec_cfg
+from ..config import resolve_prefetch_depth
 from ..data.datasets import DATASET_STATS
 from ..fed.core import combine_counted, round_rates, round_users
 from .ring_attention import ring_attention
@@ -61,7 +63,8 @@ def _bucket_pow2(n: int) -> int:
     return p
 
 
-def eval_fused_scan(step, params, xs, epochs, groups, fused_eval, eval_ops):
+def eval_fused_scan(step, params, xs, epochs, groups, fused_eval, eval_ops,
+                    params_of=None):
     """THE eval-fused scan-group walk, shared by both engines' superstep
     programs (parity-critical: the bit-identical-to-host-loop contract
     lives here, so there is exactly one copy).
@@ -76,7 +79,13 @@ def eval_fused_scan(step, params, xs, epochs, groups, fused_eval, eval_ops):
     SNAPSHOT per segment end (ys) and the eval phases run unrolled on the
     stacked snapshots -- one train-body trace, one eval trace per eval
     point, n_evals x params of transient snapshot memory.  Returns
-    ``(new_params, train_ms [k, ...], eval_ms [n_evals, ...])``."""
+    ``(new_carry, train_ms [k, ...], eval_ms [n_evals, ...])``.
+
+    ``params_of`` extracts the params tree from a compound scan carry (the
+    wire-codec supersteps carry ``(params, error-feedback residual)``,
+    ISSUE 8); None = the carry IS the params tree."""
+    if params_of is None:
+        params_of = lambda c: c  # noqa: E731
     tree_map = jax.tree_util.tree_map
     p, train_ms, eval_ms, off = params, [], [], 0
     for n, do_eval, c in groups:
@@ -86,7 +95,8 @@ def eval_fused_scan(step, params, xs, epochs, groups, fused_eval, eval_ops):
         if c == 1:
             p, ms = jax.lax.scan(step, p, tree_map(lambda x: x[0], xs_g))
             if do_eval:
-                ev = fused_eval.core(p, epochs[off + n - 1], eval_ops)
+                ev = fused_eval.core(params_of(p), epochs[off + n - 1],
+                                     eval_ops)
                 eval_ms.append(tree_map(lambda x: x[None], ev))
         else:
             # c repeats of (n train rounds + eval): only eval-bearing
@@ -95,7 +105,7 @@ def eval_fused_scan(step, params, xs, epochs, groups, fused_eval, eval_ops):
             # snapshots its params
             def seg_body(p, xs_one):
                 p, ms = jax.lax.scan(step, p, xs_one)
-                return p, (ms, p)
+                return p, (ms, params_of(p))
 
             p, (ms, snaps) = jax.lax.scan(seg_body, p, xs_g)
             ms = tree_map(lambda x: x.reshape((c * n,) + x.shape[2:]), ms)
@@ -184,7 +194,87 @@ def shard_client_data(mesh: Mesh, data: Tuple[Any, ...]) -> Tuple[jnp.ndarray, .
     return tuple(out)
 
 
-class RoundEngine:
+class _WireCodecCarry:
+    """Shared wire-codec scaffolding of both round engines (ISSUE 8): the
+    lazily-built codec object over the engine's param shapes and the
+    device-resident error-feedback residual carry, with its checkpoint
+    read/restore pair.  ONE copy on purpose -- the donation policy below is
+    a correctness pin, and a fix that lands in only one engine rots.
+
+    Donation policy: codec programs donate ONLY the resid carry.  Donating
+    the replicated params carry alongside a params-sized resid output trips
+    an XLA:CPU executable-serialization bug (jaxlib 0.4.36): the program
+    RELOADED from the persistent compile cache mis-assigns the resid output
+    buffer and returns nondeterministic garbage on a stable subset of its
+    elements, while fresh compiles are correct (caught by the checkpoint
+    round-trip tests on a warm cache -- grouped int8 and masked signsgd).
+    Cost: one extra params-size buffer per lossy-codec dispatch, priced
+    into the staticcheck HBM budgets and donation-savings accounting.
+
+    Expects on ``self``: ``mesh``, ``_codec_name``, ``_error_feedback``,
+    ``_codec_obj``, ``_resid`` (the latter two initialised to None)."""
+
+    def _codec(self, params):
+        """The engine's wire codec over these param shapes (None = dense);
+        built once.  The FlatSpec mirrors ops/fused_update's flat layout --
+        for the grouped engine these are the GLOBAL shapes (its fused
+        superstep's single psum joins the embedded level partials at global
+        shape, the same layout the masked engine compresses)."""
+        if self._codec_name == "dense":
+            return None
+        shapes = {k: tuple(v.shape) for k, v in params.items()}
+        if self._codec_obj is None or self._codec_obj.spec.shapes != shapes:
+            self._codec_obj = make_codec(self._codec_name, FlatSpec(shapes),
+                                         self.mesh.shape["clients"],
+                                         self._error_feedback)
+        return self._codec_obj
+
+    def _resid_shape(self, params) -> Tuple[int, int, int]:
+        return (self.mesh.shape["clients"], resid_slots(self._codec_name),
+                FlatSpec.of(params).total)
+
+    def _ensure_resid(self, params):
+        """The committed error-feedback carry (zeros on first use): built by
+        a jitted program so the buffer is PRIVATE and donation-safe, sharded
+        one row per device over the clients axis."""
+        from jax.sharding import NamedSharding
+
+        shape = self._resid_shape(params)
+        if self._resid is None or tuple(self._resid.shape) != shape:
+            sh = NamedSharding(self.mesh, P("clients"))
+            # staticcheck: allow(jit-needs-donation): one-time zeros init
+            # (nothing to donate); steady-state rounds donate the carry
+            self._resid = jax.jit(
+                lambda: jnp.zeros(shape, jnp.float32), out_shardings=sh)()
+        return self._resid
+
+    def wire_resid_host(self):
+        """Host copy of the error-feedback residual carry (checkpointing);
+        None for the dense codec or before the first compressed round."""
+        if self._resid is None:
+            return None
+        # staticcheck: allow(no-asarray): checkpoint-boundary D2H fetch
+        # (superstep boundaries only), not steady-state round code
+        return np.asarray(self._resid)
+
+    def set_wire_resid(self, arr) -> None:
+        """Restore the residual carry from a checkpoint (resume): committed
+        through a jitted copy so the restored buffer is donation-safe."""
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(self.mesh, P("clients"))
+        # staticcheck: allow(no-asarray): checkpoint-restore host
+        # normalization; the carry reaches the mesh via the explicit
+        # device_put + jitted private copy below
+        host = np.asarray(arr, np.float32)
+        # staticcheck: allow(jit-needs-donation): one-time restore copy
+        # severing host-buffer aliasing; donating its input would free the
+        # caller's checkpoint array
+        self._resid = jax.jit(lambda t: t + 0, out_shardings=sh)(
+            jax.device_put(host, sh))
+
+
+class RoundEngine(_WireCodecCarry):
     """Jitted train/eval/sBN programs for one (model, cfg, mesh) triple.
 
     Shapes are taken from the arrays passed in; jit re-specialises on new
@@ -230,6 +320,13 @@ class RoundEngine:
         # dispatch in the compute layout (TPU; identity on the CPU mesh);
         # the pinner caches the static Format tree across dispatches
         self._pin = ParamPinner(mesh, cfg.get("layout_policy", "auto"))
+        # wire codec (ISSUE 8): compress the aggregation payload inside the
+        # round program -- quantise -> ONE global psum -> dequantise, with
+        # the error-feedback residual as an extra donated carry.  'dense'
+        # keeps today's program bit for bit (no new args, no residual).
+        self._codec_name, self._error_feedback = resolve_codec_cfg(cfg)
+        self._codec_obj = None  # built lazily (needs the param shapes)
+        self._resid = None      # device [n_dev, slots, total] EF carry
         self._train = None
         self._superstep_progs: Dict[Tuple, Any] = {}
         self._lr_fn = None  # built on first superstep (plateau raises there)
@@ -243,8 +340,11 @@ class RoundEngine:
         # per-level sub-engines) never run train_round and skip staging.
         self._staging = PlacementCache(mesh) if mesh is not None else None
         self._packer = SlotPacker()
-        # streaming cohort pipeline (ISSUE 6): built on first stage_cohort
+        # streaming cohort pipeline (ISSUE 6): built on first stage_cohort;
+        # ring depth = cfg['stream_prefetch_depth'] (ISSUE 8 satellite:
+        # deeper pipelines once per-superstep compute shrinks on real TPUs)
         self._cohort_stager = None
+        self._prefetch_depth = resolve_prefetch_depth(cfg)
 
     # ------------------------------------------------------------------
     # per-client local training (pure; vmapped across clients)
@@ -500,7 +600,8 @@ class RoundEngine:
     # the round program
     # ------------------------------------------------------------------
 
-    def _round_core(self, params, key, lr, user_loc, user_glob, data):
+    def _round_core(self, params, key, lr, user_loc, user_glob, data,
+                    resid=None):
         """One round's in-jit core, per device (runs inside ``shard_map``):
         slot training + counted-average ``psum``.  Shared by the one-round
         program (:meth:`_build_train`) and the K-round superstep scan
@@ -514,7 +615,10 @@ class RoundEngine:
         gather); ``user_glob``: the users' global ids, used for all
         per-client randomness so results are placement- and
         mesh-shape-invariant.  -1 = padding slot.  ``data`` carries the
-        fix-rates table as its last element in fix mode."""
+        fix-rates table as its last element in fix mode.  ``resid``: this
+        device's ``[slots, total]`` error-feedback carry (lossy wire codecs
+        only; None under dense).  Returns ``(new_params, metric sums,
+        new_resid-or-None)``."""
         model, cfg, mesh = self.model, self.cfg, self.mesh
         dynamic = cfg["model_split_mode"] == "dynamic"
         # staticcheck: allow(no-float-coercion): trace-time config scalar
@@ -570,14 +674,29 @@ class RoundEngine:
             wr, lm, valid)
         summed = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in params}
         counts = {k: jnp.sum(cms[k], axis=0) for k in params}
-        # ONE psum bind for sums+counts: the round's single global collective
-        # (per-leaf addends are identical to two separate psums, so this is
-        # bit-compatible; staticcheck audits the exactly-one-psum budget)
-        summed, counts = jax.lax.psum((summed, counts), "clients")
+        codec = self._codec(params)
+        if codec is None:
+            # ONE psum bind for sums+counts: the round's single global
+            # collective (per-leaf addends are identical to two separate
+            # psums, so this is bit-compatible; staticcheck audits the
+            # exactly-one-psum budget)
+            summed, counts = jax.lax.psum((summed, counts), "clients")
+            new_resid = None
+        else:
+            # wire codec (ISSUE 8): quantise this device's partial -> the
+            # SAME single psum bind carries the packed payload -> dequantise;
+            # the error-feedback residual re-injects the compression error
+            # next round.  cmax = this device's slot count (it bounds the
+            # partial-sum magnitude, sizing the shared quantisation grid).
+            from ..compress.codecs import compressed_psum
+
+            summed, counts, new_resid = compressed_psum(
+                codec, "clients", params, summed, counts, resid, key,
+                int(user_glob.shape[0]))
         new_params = combine_counted(params, summed, counts)
         ms = {k: v * valid for k, v in ms.items()}
         ms["rate"] = rates_abs * valid
-        return new_params, ms
+        return new_params, ms, new_resid
 
     def _data_specs(self) -> Tuple[P, ...]:
         """shard_map in_specs of the ``data`` tuple (incl. the fix-rates
@@ -593,8 +712,29 @@ class RoundEngine:
         return data_specs
 
     def _build_train(self):
+        if self._codec_name != "dense":
+            # compressed round (ISSUE 8): the EF residual is an extra
+            # donated carry -- [1, slots, total] per device in, same out
+            def body(params, resid, key, lr, user_loc, user_glob, *data):
+                p, ms, r = self._round_core(params, key, lr, user_loc,
+                                            user_glob, data, resid=resid[0])
+                return p, r[None], ms
+
+            fn = _shard_map(
+                body, self.mesh,
+                in_specs=(P(), P("clients"), P(), P(), P("clients"),
+                          P("clients")) + self._data_specs(),
+                out_specs=(P(), P("clients"), P("clients")),
+            )
+            # resid-only donation: donating the params carry alongside the
+            # params-sized resid trips the XLA:CPU executable-serialization
+            # bug (see _WireCodecCarry) -- both engines pin the same policy
+            return jax.jit(fn, donate_argnums=(1,))
+
         def body(params, key, lr, user_loc, user_glob, *data):
-            return self._round_core(params, key, lr, user_loc, user_glob, data)
+            p, ms, _ = self._round_core(params, key, lr, user_loc, user_glob,
+                                        data)
+            return p, ms
 
         fn = _shard_map(
             body, self.mesh,
@@ -656,8 +796,14 @@ class RoundEngine:
         groups = superstep_eval_groups(eval_mask) if eval_mask else None
         if groups is not None and not any(ev for _, ev, _ in groups):
             groups = None  # an all-False mask is the plain train superstep
+        codec = self._codec_name != "dense"
 
-        def sbody(params, base_key, epoch0, *rest):
+        def sbody(params, *all_rest):
+            if codec:
+                # wire codec (ISSUE 8): the EF residual joins the scan carry
+                resid0, base_key, epoch0, *rest = all_rest
+            else:
+                base_key, epoch0, *rest = all_rest
             idx = 0
             if lr_arg:
                 lr_const = rest[0]
@@ -677,14 +823,17 @@ class RoundEngine:
                 data = rest[idx:idx + n_data_args]
                 eval_ops = rest[idx + n_data_args:]
 
-            def step(p, xs):
+            def step(carry, xs):
+                p, rs = carry if codec else (carry, None)
                 if streaming:
                     t, ug, *d = xs
                     key = jax.random.fold_in(base_key, t)
                     lr = lr_const if lr_arg else lr_fn(t)
                     # slot-local cohort rows: user_loc=None = identity gather
-                    return self._round_core(p, key, lr, None, ug,
-                                            tuple(d) + tuple(fix))
+                    new_p, ms, nr = self._round_core(
+                        p, key, lr, None, ug, tuple(d) + tuple(fix),
+                        resid=rs)
+                    return ((new_p, nr) if codec else new_p), ms
                 if in_jit:
                     (t,) = xs
                     key = jax.random.fold_in(base_key, t)
@@ -698,32 +847,46 @@ class RoundEngine:
                     t, ul, ug = xs
                     key = jax.random.fold_in(base_key, t)
                 lr = lr_const if lr_arg else lr_fn(t)
-                new_p, ms = self._round_core(p, key, lr, ul, ug, data)
-                return new_p, ms
+                new_p, ms, nr = self._round_core(p, key, lr, ul, ug, data,
+                                                 resid=rs)
+                return ((new_p, nr) if codec else new_p), ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
             if streaming:
                 xs = (epochs, sched_ug) + tuple(sdata)
             else:
                 xs = (epochs,) if in_jit else (epochs, sched_ul, sched_ug)
+            carry0 = (params, resid0[0]) if codec else params
             if groups is None:
-                new_params, ms = jax.lax.scan(step, params, xs)
-                return new_params, ms
-            return eval_fused_scan(step, params, xs, epochs, groups,
-                                   fused_eval, eval_ops)
+                carry, ms = jax.lax.scan(step, carry0, xs)
+                if codec:
+                    return carry[0], carry[1][None], ms
+                return carry, ms
+            carry, ms, ev = eval_fused_scan(
+                step, carry0, xs, epochs, groups, fused_eval, eval_ops,
+                params_of=(lambda c: c[0]) if codec else None)
+            if codec:
+                return carry[0], carry[1][None], ms, ev
+            return carry, ms, ev
 
         lr_specs = (P(),) if lr_arg else ()
         eval_specs = tuple(fused_eval.specs) if groups else ()
-        out_specs = (P(), P(None, "clients"))
+        resid_specs = (P("clients"),) if codec else ()
+        out_specs = (P(),) + resid_specs + (P(None, "clients"),)
         if groups is not None:
             out_specs = out_specs + (fused_eval.out_specs,)
         fn = _shard_map(
             sbody, mesh,
-            in_specs=(P(), P(), P()) + lr_specs + sched_specs + data_specs
-            + eval_specs,
+            in_specs=(P(),) + resid_specs + (P(), P()) + lr_specs
+            + sched_specs + data_specs + eval_specs,
             out_specs=out_specs,
         )
-        return jax.jit(fn, donate_argnums=(0,))
+        # codec programs donate ONLY the resid carry (see _WireCodecCarry:
+        # params donation + a params-sized resid output trips an XLA:CPU
+        # executable-serialization bug when reloaded from the persistent
+        # compile cache; caught by the masked signsgd checkpoint round-trip
+        # on a warm cache)
+        return jax.jit(fn, donate_argnums=(1,) if codec else (0,))
 
     def stage_cohort(self, store: ClientStore, user_schedule,
                      timer: PhaseTimer = None) -> StagedCohort:
@@ -755,7 +918,8 @@ class RoundEngine:
             per_dev = _ceil_div(a, n_dev)
             slots = per_dev * n_dev
             if self._cohort_stager is None:
-                self._cohort_stager = CohortStager(self.mesh)
+                self._cohort_stager = CohortStager(self.mesh,
+                                                   depth=self._prefetch_depth)
             st = self._cohort_stager
             n = store.shard_max
             if self.is_lm:
@@ -841,6 +1005,8 @@ class RoundEngine:
                 eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
                 epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
                 params = self._staging.commit(self._pin(params))
+                resid_args = () if self._codec_name == "dense" \
+                    else (self._ensure_resid(params),)
                 pkey = (k, per_dev, "stream", a, eval_mask, lr_arg)
                 prog = self._superstep_progs.get(pkey)
                 if prog is None:
@@ -851,8 +1017,8 @@ class RoundEngine:
                                                  lr_arg=lr_arg, streaming=True)
                     self._superstep_progs[pkey] = prog
             with timer.phase("dispatch"):
-                out = prog(params, base_key, epoch0_dev, *lr_args,
-                           *sched_args, *args, *eval_args)
+                out = prog(params, *resid_args, base_key, epoch0_dev,
+                           *lr_args, *sched_args, *args, *eval_args)
             return self._assemble_superstep(out, epoch0, k, eval_mask,
                                             fused_eval)
         if data is None:
@@ -924,6 +1090,8 @@ class RoundEngine:
             # outputs come back mesh-committed (staticcheck recompile audit);
             # the layout pin rides the same commit (models/layout.py policy)
             params = self._staging.commit(self._pin(params))
+            resid_args = () if self._codec_name == "dense" \
+                else (self._ensure_resid(params),)
             pkey = (k, per_dev, in_jit, a, eval_mask, lr_arg)
             prog = self._superstep_progs.get(pkey)
             if prog is None:
@@ -933,14 +1101,20 @@ class RoundEngine:
                                              lr_arg=lr_arg)
                 self._superstep_progs[pkey] = prog
         with timer.phase("dispatch"):
-            out = prog(params, base_key, epoch0_dev, *lr_args, *sched_args,
-                       *args, *eval_args)
+            out = prog(params, *resid_args, base_key, epoch0_dev, *lr_args,
+                       *sched_args, *args, *eval_args)
         return self._assemble_superstep(out, epoch0, k, eval_mask, fused_eval)
 
     def _assemble_superstep(self, out, epoch0: int, k: int, eval_mask,
                             fused_eval):
         """Package one superstep dispatch's outputs: ``(new_params,
-        PendingMetrics)``; shared by the eager and streaming paths."""
+        PendingMetrics)``; shared by the eager and streaming paths.  Under a
+        lossy wire codec the second output is the new error-feedback carry,
+        stashed on the engine (read/restored via :meth:`wire_resid_host` /
+        :meth:`set_wire_resid` at checkpoint boundaries)."""
+        if self._codec_name != "dense":
+            self._resid = out[1]
+            out = (out[0],) + out[2:]
         if eval_mask is None:
             new_params, ms = out
 
@@ -1027,5 +1201,11 @@ class RoundEngine:
             # program specialization (see train_superstep); layout pinned
             # by the same policy
             params = self._staging.commit(self._pin(params))
+            resid_args = () if self._codec_name == "dense" \
+                else (self._ensure_resid(params),)
         with timer.phase("dispatch"):
+            if self._codec_name != "dense":
+                new_p, self._resid, ms = self._train(
+                    params, *resid_args, key, lr, ul, ug, *args)
+                return new_p, ms
             return self._train(params, key, lr, ul, ug, *args)

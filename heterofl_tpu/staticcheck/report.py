@@ -78,6 +78,10 @@ class AuditReport:
     programs: Dict[str, ProgramReport] = field(default_factory=dict)
     flop_budget: Dict[str, Any] = field(default_factory=dict)
     recompile: Dict[str, Any] = field(default_factory=dict)
+    #: analytic flagship compression frontier (ISSUE 8:
+    #: audit.codec_frontier_check) -- per-codec payload bytes vs dense,
+    #: with the int8 <= 25%-of-dense acceptance line enforced
+    wire_frontier: Dict[str, Any] = field(default_factory=dict)
     lint: List[Finding] = field(default_factory=list)
     #: baseline-ratchet diff (ISSUE 7: staticcheck/ratchet.py).  ``checked``
     #: is False unless the CLI ran ``--diff-baseline``; a regressed ratchet
@@ -106,7 +110,7 @@ class AuditReport:
         out = list(self.lint)
         for p in self.programs.values():
             out.extend(p.findings)
-        for sec in (self.flop_budget, self.recompile):
+        for sec in (self.flop_budget, self.recompile, self.wire_frontier):
             out.extend(Finding(**f) for f in sec.get("findings", []))
         return out
 
@@ -119,6 +123,7 @@ class AuditReport:
             "programs": {k: asdict(v) for k, v in self.programs.items()},
             "flop_budget": self.flop_budget,
             "recompile": self.recompile,
+            "wire_frontier": self.wire_frontier,
             "ratchet": self.ratchet,
             "lint": [asdict(f) for f in self.lint],
         }

@@ -64,8 +64,11 @@ class Rule:
 _PARALLEL = ("heterofl_tpu/parallel/",)
 #: kernel/model hot-path code (ISSUE 5): ops/ and models/ run INSIDE the
 #: round programs, so the same banned-call rules apply -- trace-time
-#: constant coercions carry `allow` pragmas with their reasons
-_KERNEL = ("heterofl_tpu/ops/", "heterofl_tpu/models/")
+#: constant coercions carry `allow` pragmas with their reasons.  The wire
+#: codecs (ISSUE 8, compress/) encode/decode inside the scanned superstep,
+#: so they are hot-path code under the same rules.
+_KERNEL = ("heterofl_tpu/ops/", "heterofl_tpu/models/",
+           "heterofl_tpu/compress/")
 _TRACED = ("heterofl_tpu/parallel/", "heterofl_tpu/fed/") + _KERNEL
 _DRIVER = ("heterofl_tpu/entry/",)
 

@@ -199,6 +199,26 @@ def check_wire(rep, wire: Dict[str, Any], expected_train_bytes: int,
                  f"cross-slice reduction crept in (axes {wire['dcn_axes']})")
 
 
+def codec_round_wire(codec: str, payload_bytes: int, dense_bytes: int,
+                     participants: int) -> Dict[str, Any]:
+    """The analytic COMPRESSED-aggregation wire record for one training
+    round under ``codec`` (ISSUE 8): what ``bench.py`` writes into
+    ``extra.wire`` alongside the dense baseline.  ``payload_bytes`` must
+    come from :func:`~..fed.core.level_codec_byte_table` -- the same table
+    the staticcheck wire budget enforces by equality against the traced
+    psum operand avals, so there is no second bytes formula."""
+    return {
+        "format": codec,
+        "payload_bytes_per_round": int(payload_bytes),
+        "dense_bytes_per_round": int(dense_bytes),
+        "ratio_vs_dense": round(payload_bytes / dense_bytes, 6),
+        "reduction_x": round(dense_bytes / payload_bytes, 3),
+        "ring_allreduce_bytes_per_device":
+            ring_allreduce_bytes(payload_bytes, participants),
+        "participants": int(participants),
+    }
+
+
 def dense_round_wire(param_bytes: int, participants: int,
                      count_bytes: Optional[int] = None) -> Dict[str, Any]:
     """The analytic dense-aggregation wire record for one training round:

@@ -311,23 +311,33 @@ def test_wire_memory_reshard_sections_on_every_program(audit_report):
     """ISSUE 7 acceptance: STATICCHECK.json grows wire/memory/reshards
     sections for every audited program variant, and the wire budget of
     every fused training round equals ONE dense global reduction of the
-    level-a parameter footprint (sums + count masks, f32)."""
-    from heterofl_tpu.fed.core import level_byte_table
-    from heterofl_tpu.staticcheck.audit import default_audit_cfg
+    level-a parameter footprint (sums + count masks, f32) -- or, for the
+    ISSUE 8 codec variants, that codec's compressed level-a payload from
+    the same table family."""
+    from heterofl_tpu.compress import LOSSY_CODECS
+    from heterofl_tpu.fed.core import level_byte_table, level_codec_byte_table
+    from heterofl_tpu.staticcheck.audit import build_setup, default_audit_cfg
 
-    bt = level_byte_table(default_audit_cfg())
+    cfg = default_audit_cfg()
+    bt = level_byte_table(cfg)
     level_a_wire = bt[max(bt)]["wire_bytes"]
     assert level_a_wire == 2 * bt[max(bt)]["param_bytes"]
+    n_leaves = len(build_setup()["params"])
+    codec_wire = {c: level_codec_byte_table(cfg, c, n_leaves=n_leaves)[max(bt)]
+                  for c in LOSSY_CODECS}
     for name, p in audit_report.programs.items():
         assert p.wire is not None, name
         assert p.memory is not None, name
         assert p.reshards is not None and p.reshards["total"] == 0, name
         assert p.wire["dcn_bytes"] == 0, name  # single-slice audit mesh
+        codec = next((c for c in LOSSY_CODECS if name.endswith(f"-{c}")), None)
         if name == "grouped/span/combine":
             assert p.wire["train_bytes_per_round"] == 0
         elif "/level-" in name:  # per-level partial: that level's slice
             rate = float(name.split("level-")[1].split("/")[0])
             assert p.wire["train_bytes_per_round"] == bt[rate]["wire_bytes"], name
+        elif codec:  # compressed fused round: that codec's level-a payload
+            assert p.wire["train_bytes_per_round"] == codec_wire[codec], name
         else:  # every fused training round: the dense level-a reduction
             assert p.wire["train_bytes_per_round"] == level_a_wire, name
 
@@ -365,13 +375,15 @@ def test_auditor_flags_smuggled_io_callback(monkeypatch):
 
     orig = RoundEngine._round_core
 
-    def smuggled(self, params, key, lr, user_loc, user_glob, data):
-        new_p, ms = orig(self, params, key, lr, user_loc, user_glob, data)
+    def smuggled(self, params, key, lr, user_loc, user_glob, data,
+                 resid=None):
+        new_p, ms, new_resid = orig(self, params, key, lr, user_loc,
+                                    user_glob, data, resid=resid)
         # the smuggled host hook (e.g. a sneaky metrics push); the result is
         # discarded but the bind stays in the jaxpr, where the walk finds it
         _ = io_callback(lambda v: np.float32(0.0),
                         jax.ShapeDtypeStruct((), np.float32), lr)
-        return new_p, ms
+        return new_p, ms, new_resid
 
     monkeypatch.setattr(RoundEngine, "_round_core", smuggled)
     setup = build_setup()

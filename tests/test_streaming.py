@@ -281,6 +281,57 @@ def test_prefetch_overlaps_inflight_superstep():
         np.testing.assert_array_equal(np.asarray(pa[na]), np.asarray(pb[nb]))
 
 
+def test_prefetch_depth2_ring_reuse_safe():
+    """``stream_prefetch_depth=2`` (ISSUE 8 satellite): with TWO cohorts
+    staged ahead of the in-flight superstep the ring holds depth+1 = 3
+    slots, so cohort N+3 reuses cohort N's host buffers while N's private
+    copy may still be the scan's live operand.  Five supersteps with the
+    deepest legal pipeline must stay bit-identical to the sequential
+    depth-1 baseline (params AND every round metric) -- a refill racing an
+    in-flight superstep would corrupt exactly these."""
+    cfg, ds, data, _, store = _stream_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    k, A, n_ss = 2, 4, 5
+
+    def sched_at(e0):
+        return superstep_user_schedule(HOST, e0, k, cfg["num_users"], A)
+
+    # sequential depth-1 baseline: stage -> dispatch -> fetch, one at a time
+    eng_a = RoundEngine(model, cfg, mesh)
+    pa = model.init(jax.random.key(0))
+    base = []
+    for i in range(n_ss):
+        coh = eng_a.stage_cohort(store, sched_at(1 + i * k))
+        pa, pend = eng_a.train_superstep(pa, HOST, 1 + i * k, k, cohort=coh)
+        base.append(pend.fetch())
+
+    # depth-2 pipeline: keep TWO staged cohorts in hand at every dispatch
+    eng_b = RoundEngine(model, dict(cfg, stream_prefetch_depth=2), mesh)
+    assert eng_b._cohort_stager is None
+    pb = model.init(jax.random.key(0))
+    ready = [eng_b.stage_cohort(store, sched_at(1)),
+             eng_b.stage_cohort(store, sched_at(1 + k))]
+    assert eng_b._cohort_stager.depth == 2
+    pendings = []
+    for i in range(n_ss):
+        pb, pend = eng_b.train_superstep(pb, HOST, 1 + i * k, k,
+                                         cohort=ready.pop(0))
+        if i + 2 < n_ss:  # refill to two-ahead while this one computes
+            ready.append(eng_b.stage_cohort(store, sched_at(1 + (i + 2) * k)))
+        pendings.append(pend)
+    for i, pend in enumerate(pendings):
+        got = pend.fetch()
+        for r in range(k):
+            for nme in ("loss_sum", "score_sum", "n", "rate"):
+                np.testing.assert_array_equal(
+                    np.asarray(base[i][r][nme]), np.asarray(got[r][nme]),
+                    err_msg=f"superstep {i} round {r} {nme}")
+    for n in sorted(pa):
+        np.testing.assert_array_equal(np.asarray(pa[n]), np.asarray(pb[n]),
+                                      err_msg=f"depth-2 params {n}")
+
+
 # ---------------------------------------------------------------------------
 # O(active) memory: staging cost independent of the population
 # ---------------------------------------------------------------------------

@@ -176,13 +176,15 @@ def test_seeded_extra_psum_trips_wire_budget(setup, monkeypatch):
 
     orig = RoundEngine._round_core
 
-    def doubled(self, params, key, lr, user_loc, user_glob, data):
-        new_p, ms = orig(self, params, key, lr, user_loc, user_glob, data)
+    def doubled(self, params, key, lr, user_loc, user_glob, data,
+                resid=None):
+        new_p, ms, new_resid = orig(self, params, key, lr, user_loc,
+                                    user_glob, data, resid=resid)
         leak = jax.lax.psum(lr, "clients")  # the extra 4-byte global psum
         k0 = next(iter(new_p))
         new_p = dict(new_p)
         new_p[k0] = new_p[k0] + 0.0 * leak
-        return new_p, ms
+        return new_p, ms, new_resid
 
     monkeypatch.setattr(RoundEngine, "_round_core", doubled)
     name, prog, args, expect = _masked_targets(setup)[0]
@@ -227,14 +229,16 @@ def test_seeded_reshard_trips_detector(setup, monkeypatch):
 
     orig = RoundEngine._round_core
 
-    def shifted(self, params, key, lr, user_loc, user_glob, data):
-        new_p, ms = orig(self, params, key, lr, user_loc, user_glob, data)
+    def shifted(self, params, key, lr, user_loc, user_glob, data,
+                resid=None):
+        new_p, ms, new_resid = orig(self, params, key, lr, user_loc,
+                                    user_glob, data, resid=resid)
         n = self.mesh.shape["clients"]
         k0 = next(iter(new_p))
         new_p = dict(new_p)
         new_p[k0] = jax.lax.ppermute(
             new_p[k0], "clients", [(i, (i + 1) % n) for i in range(n)])
-        return new_p, ms
+        return new_p, ms, new_resid
 
     monkeypatch.setattr(RoundEngine, "_round_core", shifted)
     name, prog, args, expect = _masked_targets(setup)[0]
@@ -527,7 +531,7 @@ def test_cli_green_exit_and_json_schema(cli, capsys):
     rec = json.loads(capsys.readouterr().out)
     assert sorted(rec) == ["config", "flop_budget", "generated_at", "lint",
                            "ok", "programs", "ratchet", "recompile",
-                           "version"]
+                           "version", "wire_frontier"]
     prog = rec["programs"]["prog/a"]
     for key in ("wire", "memory", "reshards", "step_body", "psum_clients",
                 "donated", "aliased", "flops", "findings"):
