@@ -34,6 +34,15 @@ extra.strategies carries `<engine>+eval-fused` vs `<engine>+eval-host`
 rows, the host row paying the PR 2 clamp (dispatch windows shortened to
 min(K, E)) plus a host `eval` phase per window.
 
+BENCH_SCENARIO=<tokens> (ISSUE 9): the scheduler scenario matrix --
+comma/plus separated tokens of {uniform, markov, trace, deadline,
+buffered} building cfg['schedule'] (heterofl_tpu/sched/): markov/trace =
+replayable on/off availability (p_on .7 / p_off .3), deadline = straggler
+local-step truncation (min_frac .25), buffered = buffered-async
+staleness-weighted aggregation (needs BENCH_SUPERSTEP>1).  Scenario runs
+draw cohorts host-side through the one sampling stream and record
+per-round participation stats + rounds/sec into extra.scenario.
+
 BENCH_POPULATION=N (ISSUE 6): a population axis.  The federation grows to N
 synthetic users (up to 1e6) WITHOUT densifying per-user stacks: users window
 onto the shared synthetic sample pool via data.partition.span_population
@@ -504,6 +513,54 @@ def main():
         wire_codec = "dense"
     cfg["wire_codec"] = wire_codec
 
+    # BENCH_SCENARIO (ISSUE 9): scheduler scenario matrix -- comma/plus
+    # separated tokens of {uniform, markov, trace, deadline, buffered}
+    # building cfg['schedule'] (markov availability p_on=.7/p_off=.3,
+    # deadline min_frac=.25, buffered-async staleness .5).  Scenario runs
+    # draw their cohorts HOST-side through the one sampling stream
+    # (fed.core.superstep_user_schedule) so participation is countable, and
+    # extra.scenario records the per-round active-slot statistics next to
+    # the run's rounds/sec.
+    scenario_raw = os.environ.get("BENCH_SCENARIO", "") or ""
+    scenario_tokens = [t.strip() for t in scenario_raw.replace("+", ",").split(",")
+                       if t.strip()]
+    sched_cfg = {}
+    for t in scenario_tokens:
+        if t == "uniform":
+            continue
+        if t in ("markov", "trace"):
+            # 'trace' records/replays the markov-generated availability
+            # matrix -- the replayable-trace path with a built-in source
+            sched_cfg.update({"kind": "markov",
+                              "markov": {"p_on": 0.7, "p_off": 0.3,
+                                         "length": 64, "seed": 0}})
+        elif t == "deadline":
+            sched_cfg["deadline"] = {"min_frac": 0.25}
+        elif t == "buffered":
+            sched_cfg["aggregation"] = "buffered"
+        else:
+            print(f"bench: ignoring unknown BENCH_SCENARIO token {t!r}",
+                  file=sys.stderr)
+    if sched_cfg.get("aggregation") == "buffered" and _superstep_env <= 1:
+        print("bench: BENCH_SCENARIO buffered needs BENCH_SUPERSTEP>1 (the "
+              "staleness buffer rides the fused scan carry); dropping the "
+              "buffered token", file=sys.stderr)
+        sched_cfg.pop("aggregation")
+    sched_spec = None
+    if sched_cfg:
+        from heterofl_tpu.sched import resolve_schedule_cfg
+
+        cfg["schedule"] = sched_cfg
+        sched_spec = resolve_schedule_cfg(cfg)
+    part_stats = {"filled": []}
+
+    def track_participation(us):
+        """Count filled (id >= 0) slots per drawn round -- the scenario's
+        participation record."""
+        if sched_spec is not None:
+            part_stats["filled"].extend(
+                (np.asarray(us) >= 0).sum(axis=1).tolist())
+
     def make_engine(strat, cfg_over=None):
         c = cfg if not cfg_over else dict(cfg, **cfg_over)
         if strat == "grouped":
@@ -646,7 +703,9 @@ def main():
         from heterofl_tpu.fed.core import (superstep_rate_schedule,
                                            superstep_user_schedule)
 
-        us = superstep_user_schedule(base_key, epoch0, k_disp, users, n_active)
+        us = superstep_user_schedule(base_key, epoch0, k_disp, users,
+                                     n_active, schedule=sched_spec)
+        track_participation(us)
         if strat == "grouped":
             rates = superstep_rate_schedule(base_key, epoch0, k_disp, cfg, us)
             return eng.stage_cohort(store, us, rates, timer=tmr)
@@ -688,21 +747,40 @@ def main():
                 if not any(mask):
                     mask = None
             if strat == "grouped":
-                us = np.stack([
-                    np.asarray(round_users(jax.random.fold_in(base_key, epoch0 + j),
-                                           users, n_active))
-                    for j in range(k_disp)])
+                from heterofl_tpu.fed.core import superstep_user_schedule
+
+                us = superstep_user_schedule(base_key, epoch0, k_disp, users,
+                                             n_active, schedule=sched_spec)
+                track_participation(us)
                 params, pending = eng.train_superstep(
                     params, base_key, epoch0, k_disp, us, rates_vec[us], data,
                     timer=tmr, eval_mask=mask,
                     fused_eval=fused_ev if mask else None)
             else:
+                us = None
+                if sched_spec is not None:
+                    # scenario runs take the host-drawn schedule (same
+                    # stream as the in-jit draw) so participation is
+                    # countable per round
+                    from heterofl_tpu.fed.core import superstep_user_schedule
+
+                    us = superstep_user_schedule(base_key, epoch0, k_disp,
+                                                 users, n_active,
+                                                 schedule=sched_spec)
+                    track_participation(us)
                 params, pending = eng.train_superstep(
-                    params, base_key, epoch0, k_disp, data,
+                    params, base_key, epoch0, k_disp, data, user_schedule=us,
                     num_active=n_active, timer=tmr, eval_mask=mask,
                     fused_eval=fused_ev if mask else None)
         else:
-            user_idx = rng_.permutation(users)[:n_active].astype(np.int32)
+            if sched_spec is not None:
+                epoch = 1 + i
+                user_idx = np.asarray(round_users(
+                    jax.random.fold_in(base_key, epoch), users, n_active,
+                    avail=sched_spec.avail_row(epoch)))
+                track_participation(user_idx[None])
+            else:
+                user_idx = rng_.permutation(users)[:n_active].astype(np.int32)
             if strat == "grouped":
                 params, pending = eng.train_round(
                     params, user_idx, rates_vec[user_idx], data, 0.1,
@@ -789,6 +867,7 @@ def main():
         if eval_mode == "host" and eval_iv:
             k_disp = min(superstep, eval_iv)
         rng_ = np.random.default_rng(0)
+        part_start = len(part_stats["filled"])  # this measure()'s own draws
         t0 = time.time()
         p, pending = dispatch(eng, strat, params0, 0, tmr, rng_,
                               eval_mode=eval_mode, k_disp=k_disp)
@@ -819,6 +898,11 @@ def main():
                f"({ctx['rsec'][-1]:.2f}s/round)")
         summary = summarize(ctx["rsec"], ctx["flags"], compile_s, tmr, phases0,
                             timed_rounds, k_disp=k_disp)
+        # scenario participation of THIS measure's draws only (warmup +
+        # timed dispatches of this strategy/mode) -- without the slice the
+        # second-strategy and eval-host records would pollute the primary
+        # record's statistics
+        ctx["participation"] = list(part_stats["filled"][part_start:])
         if eval_mode is not None:
             summary["eval_mode"] = eval_mode
             summary["rounds_per_dispatch"] = k_disp
@@ -848,6 +932,22 @@ def main():
                 "sync_stages": pop_stats["sync"]}
         dt = steady_stats(ctx["rsec"], ctx["flags"])
         rps = 1.0 / dt
+        scenario_extra = {}
+        if sched_cfg:
+            filled = ctx.get("participation") or part_stats["filled"]
+            scenario_extra["scenario"] = {
+                "schedule": scenario_tokens,
+                "config": sched_cfg,
+                "participation": {
+                    "slots_per_round": n_active,
+                    "rounds_sampled": len(filled),
+                    "mean_active": (round(float(np.mean(filled)), 3)
+                                    if filled else None),
+                    "min_active": int(min(filled)) if filled else None,
+                    "max_active": int(max(filled)) if filled else None,
+                },
+                "rounds_per_sec": round(rps, 4),
+            }
         summary = summarize(ctx["rsec"], ctx["flags"], ctx["compile_s"], timer,
                             ctx["phases0"], rounds_done,
                             k_disp=ctx.get("k_disp"))
@@ -877,6 +977,7 @@ def main():
                       **({"fetch_every": fetch_every,
                           "final_loss_round": ctx["ms_round"]} if fetch_every != 1 else {}),
                       **pop_extra,
+                      **scenario_extra,
                       **({"strategies": strategies} if strategies else {}),
                       **({"step_ab": step_ab} if step_ab else {}),
                       **({"degraded": degraded} if degraded else {})},

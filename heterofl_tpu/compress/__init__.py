@@ -98,16 +98,51 @@ def codec_payload_bytes(name: str, n_elems: int, n_leaves: int = 0,
     raise ValueError(f"Not valid wire_codec: {name!r} (one of {CODEC_NAMES})")
 
 
-def resolve_codec_cfg(cfg: Dict[str, Any]) -> Tuple[str, bool]:
+def normalize_codec_map(raw: Dict[Any, Any]) -> Dict[float, str]:
+    """Normalize a per-level codec map (ISSUE 9 satellite): keys are rate
+    levels (floats, or their string forms -- JSON objects key by string),
+    values codec names.  An all-dense map collapses to the plain ``dense``
+    path at the engines; key COVERAGE of the engine's level table is the
+    engine's check (it owns the table)."""
+    out: Dict[float, str] = {}
+    for k, v in raw.items():
+        try:
+            rate = float(k)  # staticcheck: allow(no-float-coercion): host config-key parse
+        except (TypeError, ValueError):
+            raise ValueError(f"Not valid wire_codec level key: {k!r} (a rate "
+                             f"level, e.g. 1.0 or '0.0625')")
+        if v not in CODEC_NAMES:
+            raise ValueError(f"Not valid wire_codec for level {rate:g}: "
+                             f"{v!r} (one of {CODEC_NAMES})")
+        if rate in out:
+            # two string keys coercing to one rate ("1" and "1.0") would
+            # otherwise silently last-win -- the loud-validation convention
+            # says a config collision fails, never resolves arbitrarily
+            raise ValueError(f"Not valid wire_codec map: level {rate:g} "
+                             f"assigned twice (duplicate keys coerce to "
+                             f"the same rate)")
+        out[rate] = v
+    if not out:
+        raise ValueError("Not valid wire_codec: an empty per-level map")
+    return out
+
+
+def resolve_codec_cfg(cfg: Dict[str, Any]):
     """Validate ``cfg['wire_codec']`` / ``cfg['error_feedback']`` and return
-    ``(codec_name, error_feedback)``.
+    ``(codec, error_feedback)`` -- ``codec`` is a name, or a normalized
+    ``{rate: name}`` per-level map (ISSUE 9 satellite; grouped engine's
+    fused superstep only -- the engines enforce that placement).
 
     Loud ``ValueError`` on unknown values (the PR 6 convention: stale or
     typo'd config keys fail at validation, never as silent defaults
     mid-run).  ``error_feedback`` defaults True and only matters for lossy
     codecs."""
     name = cfg.get("wire_codec", "dense") or "dense"
-    if name not in CODEC_NAMES:
+    if isinstance(name, dict):
+        name = normalize_codec_map(name)
+        if all(v == "dense" for v in name.values()):
+            name = "dense"
+    elif name not in CODEC_NAMES:
         raise ValueError(f"Not valid wire_codec: {name!r} "
                          f"(one of {CODEC_NAMES})")
     ef = cfg.get("error_feedback", True)

@@ -202,6 +202,36 @@ DEFAULT_CFG: Dict[str, Any] = {
     # is the convergence-preserving setting; False drops the error -- the
     # A/B the convergence contract test pins.  Ignored by "dense".
     "error_feedback": True,
+    # client scheduler (ISSUE 9, heterofl_tpu/sched/): who trains, for how
+    # long, and when their update lands.  None (default) = lockstep -- the
+    # paper's semantics, bit-identical to the pre-scheduler engines (zero
+    # new program arguments).  A dict selects scenario mechanisms, all
+    # running inside the fused K-round scan:
+    #   {"kind": "uniform"|"trace"|"markov",  # availability schedule
+    #    "trace": [[0/1,...],...],    # kind='trace': [rounds, num_users]
+    #    "markov": {"p_on": .5, "p_off": .2, "length": 64, "seed": 0},
+    #    "deadline": {"min_frac": 0.25},  # straggler local-step truncation
+    #    "aggregation": "sync"|"buffered",  # buffered-async (staleness) or
+    #    "staleness": 0.5}                  # its mixing coefficient alpha
+    # Availability slots that cannot fill surface as -1 (padding) ids --
+    # partial participation, not resampling.  Trace/markov schedules are
+    # replayable from the config/seed, so checkpoint resume reproduces
+    # identical cohorts and streaming prefetch keeps overlapping.  The
+    # deadline and buffered modes have explicit contracts (superstep ==
+    # sequential with the staleness buffer bit-for-bit; accuracy vs
+    # lockstep recorded in MEASUREMENTS.md) instead of the dense bitwise
+    # ones; buffered cannot combine with a lossy wire_codec (both add a
+    # scan carry) and scenario schedules need a mesh-native strategy.
+    "schedule": None,
+    # sampled/rolling eval cohort (ISSUE 9 satellite): with
+    # client_store='stream', evaluate the per-user Local metrics on a
+    # rolling N-user window instead of the whole population -- local eval
+    # cost becomes O(eval_cohort), which is what makes eval_interval
+    # affordable on a million-user run.  The window advances per eval
+    # cadence (deterministic in the epoch, so resume is stable); sBN and
+    # Global eval still cover their full sets.  None = whole-population
+    # local eval (the pre-scheduler behaviour, warned past 1e5 users).
+    "eval_cohort": None,
     "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
     # Applied LAST by process_control: per-key overrides of any derived field
@@ -405,9 +435,14 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
     # error_feedback values fail HERE, at config validation, with the PR 6
     # loud-ValueError convention -- never as a silent dense fallback mid-run
     from .compress import resolve_codec_cfg
+    from .sched import resolve_schedule_cfg
 
     resolve_codec_cfg(cfg)
     resolve_prefetch_depth(cfg)
+    # scheduler validation (ISSUE 9): unknown kinds/keys or a trace whose
+    # user axis disagrees with num_users fail HERE, at config time
+    resolve_schedule_cfg(cfg)
+    resolve_eval_cohort(cfg)
     return cfg
 
 
@@ -424,6 +459,26 @@ def resolve_prefetch_depth(cfg: Dict[str, Any]) -> int:
         raise ValueError(f"Not valid stream_prefetch_depth: {depth!r} "
                          f"(an int >= 1)")
     return depth
+
+
+def resolve_eval_cohort(cfg: Dict[str, Any]):
+    """Validate ``cfg['eval_cohort']`` and return it (ISSUE 9 satellite).
+    THE one validator: process_control applies it and the driver re-applies
+    it (cross-field constraints -- streaming store, vision models -- live
+    in the driver, which owns those facts)."""
+    ec = cfg.get("eval_cohort")
+    if ec is None:
+        return None
+    if not isinstance(ec, int) or isinstance(ec, bool) or ec < 1:
+        raise ValueError(f"Not valid eval_cohort: {ec!r} (an int >= 1, the "
+                         f"rolling Local-eval window size, or None for "
+                         f"whole-population local eval)")
+    users = cfg.get("num_users")
+    if users is not None and ec > int(users):
+        raise ValueError(f"Not valid eval_cohort: {ec} exceeds "
+                         f"num_users={users} (drop eval_cohort for "
+                         f"whole-population local eval)")
+    return ec
 
 
 def ceil_width(size: int, rate: float) -> int:

@@ -35,8 +35,9 @@ from ..data import (
     stack_client_shards,
     stack_client_token_rows,
 )
-from ..fed.core import (round_rates, superstep_rate_schedule,
+from ..fed.core import (round_rates, round_users, superstep_rate_schedule,
                         superstep_user_schedule, validate_width_geometry)
+from ..sched import resolve_schedule_cfg
 from ..models import make_model
 from ..parallel import (ClientStore, MetricsPipeline, PendingMetrics,
                         PhaseTimer, RoundEngine, make_mesh)
@@ -226,6 +227,13 @@ class FedExperiment:
         # never runs a silently-dense experiment; the lossy codecs need the
         # engines' single-global-psum programs
         self.wire_codec, self.error_feedback = resolve_codec_cfg(cfg)
+        if isinstance(self.wire_codec, dict) \
+                and cfg.get("strategy") != "grouped":
+            raise ValueError(
+                "a per-level wire_codec map needs strategy='grouped' (its "
+                "fused superstep compresses each level's sliced payload "
+                "under that level's codec); the other strategies have no "
+                "levels to assign codecs to")
         if self.wire_codec != "dense":
             if cfg.get("strategy") == "sliced":
                 raise ValueError(
@@ -325,6 +333,46 @@ class FedExperiment:
                     f"metrics_fetch_every={self.metrics_pipe.fetch_every} exceeds "
                     f"eval_interval={eval_iv}: each eval boundary flushes the metric "
                     f"pipeline, so the effective fetch batch is eval_interval rounds")
+        # client scheduler (ISSUE 9, heterofl_tpu/sched/): validated loudly
+        # here so scenario configs fail at construction, not mid-run.  The
+        # lockstep default changes nothing (bit-identical engines).
+        self.sched_spec = resolve_schedule_cfg(cfg)
+        if not self.sched_spec.lockstep and cfg.get("strategy") == "sliced":
+            raise ValueError(
+                "schedule scenarios (trace/markov availability, deadline, "
+                "buffered aggregation) need a mesh-native strategy "
+                "('masked' or 'grouped'): the sliced debug twin replays the "
+                "reference host loop")
+        if self.sched_spec.buffered:
+            if self.wire_codec != "dense":
+                raise ValueError(
+                    "schedule aggregation='buffered' cannot combine with a "
+                    "lossy wire_codec yet: both add a scan carry with its "
+                    "own donation/checkpoint contract -- pick one per "
+                    "experiment")
+            if cfg.get("strategy") == "grouped" \
+                    and self.superstep_rounds <= 1 and not self.streaming:
+                raise ValueError(
+                    "schedule aggregation='buffered' with the grouped "
+                    "strategy needs the fused superstep (superstep_rounds "
+                    "> 1 or client_store='stream'): the K=1 "
+                    "host-orchestrated path combines in its own program "
+                    "and has no scan carry to buffer")
+        # sampled/rolling eval cohort (ISSUE 9 satellite): O(eval_cohort)
+        # Local eval for streaming populations; loud cross-field checks
+        self.eval_cohort = C.resolve_eval_cohort(cfg)
+        if self.eval_cohort is not None:
+            if not self.streaming:
+                raise ValueError(
+                    "eval_cohort needs client_store='stream': the eager "
+                    "store already densifies the population, so its local "
+                    "eval is O(num_users) either way")
+            if self.kind != "vision":
+                raise ValueError(
+                    "eval_cohort samples the per-user Local eval, which "
+                    "only vision experiments run (LM evaluates Global "
+                    "only)")
+        self._eval_widx = None  # rolling Local-eval window currently staged
         self._fused = None  # FusedEval, built on first eval-bearing superstep
         self.alt_engine = None
         if cfg.get("strategy") == "sliced":
@@ -401,11 +449,29 @@ class FedExperiment:
         U = cfg["num_users"]
         test_split, label_split = self._eval_split
         if self.kind == "vision":
+            if self.eval_cohort is not None:
+                # sampled/rolling eval cohort (ISSUE 9 satellite): Local
+                # eval stages O(eval_cohort) per window instead of O(U) --
+                # the one population-scaling surface the streaming store
+                # left (and the reason the O(U) warning below is retired
+                # on this path).  sBN and Global keep their full sets.
+                self.sbn_batches = _batch_array(self.dataset["train"].data,
+                                                cfg["batch_size"]["train"])
+                b = cfg["batch_size"]["test"]
+                te = self.dataset["test"]
+                xg, wg = _batch_array(te.data, b)
+                yg, _ = _batch_array(te.target, b)
+                self.global_eval = (xg, yg, wg)
+                self.local_eval = None  # staged per rolling window
+                self._eval_staged = True
+                return
             if U > 100_000:
                 warnings.warn(
                     f"local eval stages every user's test shard (O(U) at "
-                    f"num_users={U}); cap eval_interval past num_epochs or "
-                    f"stick to population benches if this OOMs")
+                    f"num_users={U}); set eval_cohort for a rolling "
+                    f"O(cohort) Local eval, cap eval_interval past "
+                    f"num_epochs, or stick to population benches if this "
+                    f"OOMs")
             lm = label_split_masks(label_split, U, cfg["classes_size"])
             self.sbn_batches, self.local_eval, self.global_eval = \
                 stage_eval_operands(cfg, self.dataset["train"],
@@ -418,11 +484,22 @@ class FedExperiment:
 
     # -- one round -----------------------------------------------------
 
-    def sample_users(self) -> np.ndarray:
-        return self.rng.permutation(self.cfg["num_users"])[: self.num_active].astype(np.int32)
+    def sample_users(self, epoch: int) -> np.ndarray:
+        """The K=1 host draw.  Uniform keeps the drivers' legacy numpy
+        permutation stream (reference parity, bit-identical trajectories);
+        availability schedules draw through THE shared sampling stream
+        (:func:`~..fed.core.round_users` at the round key) so the K=1 and
+        superstep paths replay the same trace -- unavailable slots come
+        back -1 and flow through the engines as padding."""
+        if self.sched_spec.kind == "uniform":
+            return self.rng.permutation(self.cfg["num_users"])[: self.num_active].astype(np.int32)
+        key = jax.random.fold_in(self.host_key, epoch)
+        return np.asarray(round_users(key, self.cfg["num_users"],
+                                      self.num_active,
+                                      avail=self.sched_spec.avail_row(epoch)))
 
     def train_round(self, params, epoch: int, lr: float, logger: Logger):
-        user_idx = self.sample_users()
+        user_idx = self.sample_users(epoch)
         key = jax.random.fold_in(self.host_key, epoch)
         t0 = time.time()
         phases0 = self.phase_timer.snapshot()
@@ -482,9 +559,12 @@ class FedExperiment:
         """Host-side [k, A] active-user draw from the superstep sampling
         stream (fed.core.superstep_user_schedule): what the masked engine
         samples in-jit, evaluated on the host where slot packing needs the
-        ids (sharded placement, grouped level grouping, cohort staging)."""
+        ids (sharded placement, grouped level grouping, cohort staging).
+        The availability schedule (ISSUE 9) threads through the shared
+        stream, so host- and in-jit-sampled paths replay the same trace."""
         return superstep_user_schedule(self.host_key, epoch0, k,
-                                       self.cfg["num_users"], self.num_active)
+                                       self.cfg["num_users"], self.num_active,
+                                       schedule=self.sched_spec)
 
     # -- streaming cohort pipeline (ISSUE 6) ---------------------------
 
@@ -535,15 +615,68 @@ class FedExperiment:
             e += k
 
     def _codec_engine(self):
-        """The engine holding the wire-codec error-feedback carry (the one
-        that dispatches the compressed programs)."""
+        """The engine holding the wire-codec error-feedback carry and the
+        buffered-async staleness buffer (the one that dispatches the
+        carry-bearing programs)."""
         return self.alt_engine if self.cfg.get("strategy") == "grouped" \
             else self.engine
 
-    def _fused_eval(self):
+    def _eval_cohort_users(self, widx: int) -> list:
+        """The rolling Local-eval window: ``eval_cohort`` consecutive users
+        starting at ``widx * eval_cohort`` (mod the population) -- each eval
+        window advances the cohort, so repeated evals sweep the population.
+        Deterministic in ``widx`` (itself derived from the eval epoch), so
+        checkpoint resume stages the identical window."""
+        n, u = self.eval_cohort, self.cfg["num_users"]
+        return [int(x) for x in (widx * n + np.arange(n)) % u]
+
+    def _local_cohort_operands(self, widx: int):
+        """Stage the rolling window's Local-eval operands (O(cohort) host
+        gather + device commit; same batched layout as the population
+        path's ``stage_local_eval``).  Shards pad to the POPULATION-wide
+        max test-shard size so every window shares one operand shape -- the
+        cached superstep program then takes each window as plain arguments
+        instead of recompiling per window."""
+        users = self._eval_cohort_users(widx)
+        test_split, label_split = self._eval_split
+        if not hasattr(self, "_eval_shard_max"):
+            self._eval_shard_max = max(
+                len(test_split[u]) for u in range(self.cfg["num_users"]))
+        te = self.dataset["test"]
+        xu, yu, mu = stack_client_shards(te.data, te.target, test_split,
+                                         users)
+        n = self._eval_shard_max
+        if xu.shape[1] < n:
+            pad = n - xu.shape[1]
+            xu = np.concatenate(
+                [xu, np.zeros((len(users), pad) + xu.shape[2:], xu.dtype)], 1)
+            yu = np.concatenate(
+                [yu, np.zeros((len(users), pad), yu.dtype)], 1)
+            mu = np.concatenate(
+                [mu, np.zeros((len(users), pad), np.float32)], 1)
+        lm = label_split_masks({i: label_split[u] for i, u in enumerate(users)},
+                               len(users), self.cfg["classes_size"])
+        b = min(self.cfg["batch_size"]["test"], n)
+        return stage_local_eval(xu, yu, mu, b) + (lm,)
+
+    def _fused_eval(self, widx: Optional[int] = None):
         """The experiment's :class:`~..parallel.evaluation.FusedEval`: eval
         operands committed once (shared with the host-path memos), built
-        lazily on the first eval-bearing superstep."""
+        lazily on the first eval-bearing superstep.
+
+        ``widx`` (rolling eval cohort, ISSUE 9 satellite): the Local-eval
+        window to stage.  A window change re-stages ONLY the cohort's local
+        operands and rebuilds the FusedEval wrapper around them -- the sBN/
+        Global commits are identity memo hits and the engines' cached
+        superstep programs take the new operands as plain arguments (same
+        avals, no recompile)."""
+        if self.eval_cohort is not None and widx != self._eval_widx:
+            self._ensure_eval_staged()
+            local = self._local_cohort_operands(widx)
+            self._fused = self.evaluator.fused(
+                sbn_batches=self.sbn_batches, local_eval=local,
+                global_eval=self.global_eval)
+            self._eval_widx = widx
         if self._fused is None:
             self._ensure_eval_staged()
             if self.kind == "vision":
@@ -570,7 +703,14 @@ class FedExperiment:
         n_rounds = cfg["num_epochs"]["global"]
         mask = tuple((epoch0 + r) % self.eval_interval == 0
                      or (epoch0 + r) == n_rounds for r in range(k))
-        fused = self._fused_eval() if any(mask) else None
+        widx = None
+        if any(mask) and self.eval_cohort is not None:
+            # rolling Local-eval window (ISSUE 9 satellite): derived from
+            # this superstep's FIRST eval epoch, so the sweep is
+            # deterministic in the cadence and stable across resume
+            first_eval = min(epoch0 + r for r in range(k) if mask[r])
+            widx = first_eval // self.eval_interval
+        fused = self._fused_eval(widx) if any(mask) else None
         plateau = isinstance(self.scheduler, PlateauScheduler)
         # Plateau holds the LR constant between metric steps, and steps only
         # at superstep boundaries (validated in __init__): the superstep
@@ -771,6 +911,11 @@ class FedExperiment:
                 # checkpointed run already accounted for (weights-only
                 # resume_mode=2 intentionally resets it to zeros)
                 self._codec_engine().set_wire_resid(blob["wire_resid"])
+            if blob.get("sched_buf") is not None:
+                # resume the buffered-async staleness carry (ISSUE 9):
+                # cohort k's in-flight update survives the checkpoint
+                # boundary, so a resumed run replays the exact trajectory
+                self._codec_engine().set_sched_buf(blob["sched_buf"])
             if "epoch" in blob:
                 last_epoch = blob["epoch"]
                 pivot = blob.get("pivot", pivot)
@@ -841,6 +986,10 @@ class FedExperiment:
                 # boundary (ISSUE 8; None under the dense codec)
                 "wire_resid": (self._codec_engine().wire_resid_host()
                                if self.wire_codec != "dense" else None),
+                # the buffered-async staleness carry at this superstep
+                # boundary (ISSUE 9; None under sync aggregation)
+                "sched_buf": (self._codec_engine().sched_buf_host()
+                              if self.sched_spec.buffered else None),
                 "pivot": pivot,
                 "logger_history": dict(logger.history),
                 "logger_state": logger.state_dict(),

@@ -97,7 +97,8 @@ def round_rates(round_key: jax.Array, cfg: Dict[str, Any],
     return sample_model_rates(jax.random.fold_in(round_key, ROUND_RATE_SALT), cfg, user_idx)
 
 
-def round_users(round_key: jax.Array, num_users: int, num_active: int) -> jnp.ndarray:
+def round_users(round_key: jax.Array, num_users: int, num_active: int,
+                avail=None) -> jnp.ndarray:
     """The per-round active-client draw, salt included: THE one definition
     of the superstep sampling stream (the jax twin of the drivers'
     ``rng.permutation(num_users)[:num_active]``).  Consumed in-jit by the
@@ -105,23 +106,46 @@ def round_users(round_key: jax.Array, num_users: int, num_active: int) -> jnp.nd
     slot schedules (sharded placement, grouped engine) -- every consumer
     must use this function or superstep-vs-sequential equivalence silently
     becomes a PRNG artifact.  Traceable (``round_key`` may be a traced
-    key)."""
+    key).
+
+    ``avail`` (ISSUE 9, :mod:`~..sched`): this round's ``[num_users]`` 0/1
+    availability row.  ``None`` (uniform) keeps today's draw bit for bit.
+    With a row, available users are drawn FIRST in permutation order and
+    slots the availability cannot fill come back as ``-1`` -- the engines'
+    padding-slot convention, so a thin round degrades to partial
+    participation instead of resampling unavailable users.  An all-ones
+    row selects exactly the uniform cohort (the stable sort preserves
+    permutation order), which is what makes trace replay a strict
+    generalisation of the uniform stream."""
     perm = jax.random.permutation(
         jax.random.fold_in(round_key, USER_SAMPLE_SALT), num_users)
-    return perm[:num_active].astype(jnp.int32)
+    if avail is None:
+        return perm[:num_active].astype(jnp.int32)
+    a = jnp.asarray(avail, jnp.float32)[perm]
+    order = jnp.argsort(-a, stable=True)[:num_active]
+    sel = perm[order]
+    ok = a[order] > 0
+    return jnp.where(ok, sel, -1).astype(jnp.int32)
 
 
 def superstep_user_schedule(host_key: jax.Array, epoch0: int, k: int,
-                            num_users: int, num_active: int) -> np.ndarray:
+                            num_users: int, num_active: int,
+                            schedule=None) -> np.ndarray:
     """Host-side ``[k, A]`` active-user draw from THE superstep sampling
     stream (:func:`round_users` at per-round keys ``fold_in(host_key,
     epoch0 + r)``): the one host twin of the masked engine's in-jit draw.
     Shared by the fed drivers, ``bench.py``, the streaming cohort staging
     and the equivalence tests -- a private copy of this loop is how the
-    superstep stream silently forks."""
+    superstep stream silently forks.
+
+    ``schedule`` (ISSUE 9): a :class:`~..sched.ScheduleSpec`; its per-round
+    availability rows thread into :func:`round_users` (``None`` or the
+    uniform kind leaves the stream untouched).  ``-1`` entries mark slots
+    the availability could not fill -- padding slots to every consumer."""
     return np.stack([
-        np.asarray(round_users(jax.random.fold_in(host_key, epoch0 + r),
-                               num_users, num_active))
+        np.asarray(round_users(
+            jax.random.fold_in(host_key, epoch0 + r), num_users, num_active,
+            avail=None if schedule is None else schedule.avail_row(epoch0 + r)))
         for r in range(k)])
 
 
@@ -283,6 +307,30 @@ def level_codec_byte_table(cfg: Dict[str, Any], codec: str,
 
     return {r: codec_payload_bytes(codec, n, n_leaves)
             for r, n in level_param_table(cfg, rates).items()}
+
+
+def level_codec_map_byte_table(cfg: Dict[str, Any],
+                               codec_map: Dict[float, str],
+                               rates: Optional[list] = None,
+                               n_leaves: int = 0) -> Dict[float, int]:
+    """Analytic per-level wire bytes of one fused GROUPED round under a
+    per-level codec map (ISSUE 9 satellite): level ``r``'s payload is its
+    SLICED flat element count priced by its own codec -- dense levels move
+    ``2 x 4 x n_r`` (f32 sums + counts at sliced shape), lossy levels their
+    packed-lane footprint -- and the round's single psum carries the sum
+    over levels.  Same single bytes formula
+    (:func:`~..compress.codec_payload_bytes`) as every other wire budget,
+    so staticcheck still enforces the per-level-codec programs by equality
+    against the traced psum operand avals."""
+    from ..compress import codec_payload_bytes
+
+    table = level_param_table(cfg, rates)
+    missing = set(table) - {float(r) for r in codec_map}
+    if missing:
+        raise ValueError(f"codec map misses levels {sorted(missing)}: every "
+                         f"level in the rate table needs a codec")
+    return {r: codec_payload_bytes(codec_map[float(r)], n, n_leaves)
+            for r, n in table.items()}
 
 
 def level_flop_shares(cfg: Dict[str, Any],

@@ -69,6 +69,10 @@ from ..fed.core import (combine_counted, embed_sliced_jnp, extract_sliced_jnp,
 from ..models import make_model
 from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
+from ..ops.fused_update import FlatSpec
+from ..sched import resolve_schedule_cfg
+from ..sched.buffer import _SchedBufCarry, buffered_combine
+from ..sched.deadline import deadline_steps
 from ..utils.optim import make_traced_lr_fn
 from .round_engine import (RoundEngine, _bucket_pow2, _ceil_div,
                            _shard_map, _WireCodecCarry)
@@ -76,7 +80,7 @@ from .staging import (ClientStore, CohortStager, PendingMetrics, PhaseTimer,
                       PlacementCache, SlotPacker, StagedCohort)
 
 
-class GroupedRoundEngine(_WireCodecCarry):
+class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
     """Mesh-native sliced strategy: same public round signature as
     ``fed.sliced.SlicedFederation`` (host-side rates in, per-slot metrics
     out), but every program runs on the mesh and aggregation state never
@@ -131,6 +135,41 @@ class GroupedRoundEngine(_WireCodecCarry):
         self._codec_name, self._error_feedback = resolve_codec_cfg(cfg)
         self._codec_obj = None
         self._resid = None
+        # per-level codec selection (ISSUE 9 satellite): a {rate: codec}
+        # map compresses each level's SLICED partial under its own codec in
+        # the one fused-superstep psum bind -- level-a int8 / level-e dense
+        # and friends.  Span layout only: the slices layout's lax.switch
+        # would need every branch to emit every level's payload structure.
+        self._codec_map = None
+        if isinstance(self._codec_name, dict):
+            level_set = {float(r) for r in self.levels}  # staticcheck: allow(no-float-coercion): constructor-time config parse
+            map_set = set(self._codec_name)
+            if map_set != level_set:
+                raise ValueError(
+                    f"per-level wire_codec map keys {sorted(map_set)} do "
+                    f"not match the engine's level table "
+                    f"{sorted(level_set)}: every level needs exactly one "
+                    f"codec")
+            if self.level_placement == "slices":
+                raise ValueError(
+                    "a per-level wire_codec map needs level_placement="
+                    "'span': under 'slices' each device row runs one "
+                    "level's switch branch, which cannot emit the other "
+                    "levels' payload structures")
+            self._codec_map = self._codec_name
+            self._codec_name = "per-level"  # truthy sentinel; never a codec
+        self._map_lay = None  # cached per-level FlatSpec layout
+        self._map_codec_objs: Dict[Tuple, Any] = {}
+        # scheduler (ISSUE 9): deadline + buffered-async ride the fused
+        # superstep; availability schedules reach this engine through the
+        # host-packed user/rate schedules (superstep_user_schedule)
+        self._sched_spec = resolve_schedule_cfg(cfg)
+        self._sched_buf = None
+        if self._sched_spec.buffered and self._codec_name != "dense":
+            raise ValueError(
+                "schedule aggregation='buffered' cannot combine with a "
+                "lossy wire_codec yet: both add a scan carry with its own "
+                "donation/checkpoint contract -- pick one per experiment")
         if self.level_placement == "slices":
             if jax.process_count() > 1:
                 # slice boundaries are not host-aligned yet: a level whose
@@ -189,6 +228,59 @@ class GroupedRoundEngine(_WireCodecCarry):
             lo += int(n)
         return out
 
+    # -- per-level codec layout (ISSUE 9 satellite) --------------------
+
+    def _map_layout(self, params) -> Dict[str, Any]:
+        """Per-level flat layout of the per-level codec map: each level's
+        sliced :class:`~..ops.fused_update.FlatSpec` plus the LOSSY levels'
+        offsets into one concatenated ``[2, total_lossy]`` error-feedback
+        carry (row 1 is only written by ``topk``; the quantising codecs use
+        row 0).  Cached by the global param shapes -- a trace-time
+        constant, like the codec objects themselves."""
+        shapes_key = tuple((k, tuple(v.shape))
+                           for k, v in sorted(params.items()))
+        if self._map_lay is not None and self._map_lay[0] == shapes_key:
+            return self._map_lay[1]
+        gm = self.global_model
+        sds = {k: jax.ShapeDtypeStruct(tuple(v.shape), jnp.float32)
+               for k, v in params.items()}
+        specs, offsets, off = {}, {}, 0
+        for rate in sorted(self.levels, reverse=True):
+            wr = rate / self.global_rate
+            sub = jax.eval_shape(
+                lambda p, w=wr: extract_sliced_jnp(p, gm.specs, gm.groups, w),
+                sds)
+            spec_l = FlatSpec({k: tuple(v.shape) for k, v in sub.items()})
+            specs[rate] = spec_l
+            if self._codec_map[rate] != "dense":
+                offsets[rate] = off
+                off += spec_l.total
+        lay = {"specs": specs, "offsets": offsets, "total_lossy": off}
+        self._map_lay = (shapes_key, lay)
+        return lay
+
+    def _map_codec(self, rate: float, spec_l: FlatSpec):
+        """The (cached) codec object of one lossy level in the per-level
+        map, over that level's sliced flat layout."""
+        key = (float(rate), spec_l.total)  # staticcheck: allow(no-float-coercion): host cache key (rate is a python level)
+        obj = self._map_codec_objs.get(key)
+        if obj is None:
+            obj = make_codec(self._codec_map[rate], spec_l,
+                             self.mesh.shape["clients"],
+                             self._error_feedback)
+            self._map_codec_objs[key] = obj
+        return obj
+
+    def _resid_shape(self, params):
+        """Per-level codec maps carry ONE concatenated EF residual
+        ``[n_dev, 2, total_lossy]`` (sharded over clients rows like the
+        single-codec carry); everything else defers to
+        :class:`~.round_engine._WireCodecCarry`."""
+        if self._codec_map is None:
+            return super()._resid_shape(params)
+        return (self.mesh.shape["clients"], 2,
+                self._map_layout(params)["total_lossy"])
+
     # -- per-level program ---------------------------------------------
 
     def _level_core(self, rate: float, params, key, lr, uarr, data,
@@ -224,19 +316,44 @@ class GroupedRoundEngine(_WireCodecCarry):
         lm = lm_all if local_data else lm_all[ugid]
         if self.is_lm:
             rows = data[0] if local_data else data[0][ugid]
-            trained, ms = jax.vmap(
-                lambda r_, l_, k_: eng_l._local_train_lm(
-                    sub, 1.0, r_, l_, k_, lr, scaler_rate=wr,
-                    data_axis=data_axis, n_data=n_data)
-            )(rows, lm, slot_keys)
+            if self._sched_spec.has_deadline:
+                # deadline stragglers (ISSUE 9): the masked engine's exact
+                # per-client budget draw (same round key + global uid, same
+                # static E x S total) -- per-level masks, engine-invariant
+                total_steps = eng_l.local_epochs * _ceil_div(
+                    int(rows.shape[-1]), eng_l.bptt)
+                limits = deadline_steps(key, ugid, total_steps,
+                                        self._sched_spec.deadline_min_frac)
+                trained, ms = jax.vmap(
+                    lambda r_, l_, k_, lim_: eng_l._local_train_lm(
+                        sub, 1.0, r_, l_, k_, lr, scaler_rate=wr,
+                        data_axis=data_axis, n_data=n_data, step_limit=lim_)
+                )(rows, lm, slot_keys, limits)
+            else:
+                trained, ms = jax.vmap(
+                    lambda r_, l_, k_: eng_l._local_train_lm(
+                        sub, 1.0, r_, l_, k_, lr, scaler_rate=wr,
+                        data_axis=data_axis, n_data=n_data)
+                )(rows, lm, slot_keys)
         else:
             xs, ys, sms = (data[0], data[1], data[2]) if local_data \
                 else (data[0][ugid], data[1][ugid], data[2][ugid])
-            trained, ms = jax.vmap(
-                lambda x_, y_, m_, l_, k_: eng_l._local_train_vision(
-                    sub, 1.0, x_, y_, m_, l_, k_, lr, scaler_rate=wr,
-                    data_axis=data_axis, n_data=n_data)
-            )(xs, ys, sms, lm, slot_keys)
+            if self._sched_spec.has_deadline:
+                total_steps = eng_l.local_epochs * _ceil_div(
+                    int(xs.shape[1]), eng_l.batch_size)
+                limits = deadline_steps(key, ugid, total_steps,
+                                        self._sched_spec.deadline_min_frac)
+                trained, ms = jax.vmap(
+                    lambda x_, y_, m_, l_, k_, lim_: eng_l._local_train_vision(
+                        sub, 1.0, x_, y_, m_, l_, k_, lr, scaler_rate=wr,
+                        data_axis=data_axis, n_data=n_data, step_limit=lim_)
+                )(xs, ys, sms, lm, slot_keys, limits)
+            else:
+                trained, ms = jax.vmap(
+                    lambda x_, y_, m_, l_, k_: eng_l._local_train_vision(
+                        sub, 1.0, x_, y_, m_, l_, k_, lr, scaler_rate=wr,
+                        data_axis=data_axis, n_data=n_data)
+                )(xs, ys, sms, lm, slot_keys)
         # counted sums in SLICED shape (within the slice the width mask is
         # all-ones by construction; only the label-split restriction remains)
         sub_shapes = {k: v.shape for k, v in sub.items()}
@@ -346,6 +463,12 @@ class GroupedRoundEngine(_WireCodecCarry):
                 f"superstep (set superstep_rounds > 1 or client_store="
                 f"'stream'): the K=1 host-orchestrated path reduces per "
                 f"level and has no single global psum to compress")
+        if self._sched_spec.buffered:
+            raise ValueError(
+                "schedule aggregation='buffered' needs the fused grouped "
+                "superstep (set superstep_rounds > 1 or client_store="
+                "'stream'): the K=1 host-orchestrated path combines in its "
+                "own program and has no scan carry to buffer")
         timer = timer if timer is not None else PhaseTimer()
         n_dev = self.mesh.shape["clients"]
         with timer.phase("stage"):
@@ -509,6 +632,8 @@ class GroupedRoundEngine(_WireCodecCarry):
 
         n_data_args = 2 if self.is_lm else 4
         codec = self._codec_name != "dense"
+        per_level = self._codec_map is not None
+        buffered = self._sched_spec.buffered
         # per-device max contributing clients: the span layout runs every
         # level's slots on every device, the slices layout one level's --
         # this bounds the partial-sum magnitude the codec's grid must cover
@@ -517,6 +642,8 @@ class GroupedRoundEngine(_WireCodecCarry):
         def sbody(params, *all_rest):
             if codec:
                 resid0, base_key, epoch0, *rest = all_rest
+            elif buffered:
+                buf0, base_key, epoch0, *rest = all_rest
             else:
                 base_key, epoch0, *rest = all_rest
             idx = 0
@@ -533,13 +660,76 @@ class GroupedRoundEngine(_WireCodecCarry):
                 eval_ops = rest[idx + 1 + n_data_args:]
 
             def step(carry, xs):
-                p, rs = carry if codec else (carry, None)
+                if codec:
+                    p, rs, sb = carry[0], carry[1], None
+                elif buffered:
+                    p, rs, sb = carry[0], None, carry[1]
+                else:
+                    p, rs, sb = carry, None, None
                 if streaming:
                     t, srow, *d = xs
                 else:
                     t, srow = xs
                 key = jax.random.fold_in(base_key, t)
                 lr = lr_const if lr_arg else lr_fn(t)
+                if per_level:
+                    # per-level codec selection (ISSUE 9 satellite): each
+                    # level's SLICED counted sums join the round's ONE psum
+                    # bind under that level's own codec -- dense levels ship
+                    # raw f32 at sliced shape, lossy levels their packed
+                    # lanes, and the EF residuals of the lossy levels
+                    # concatenate into one [2, total_lossy] carry.  Span
+                    # layout only (validated at construction).
+                    lay = self._map_layout(p)
+                    payload, ms_levels, dec = {}, [], {}
+                    for li, rate in enumerate(level_rates):
+                        d_li = tuple(x[li] for x in d) if streaming else data
+                        s_l, c_l, ms_l = self._level_core(
+                            rate, p, key, lr, srow[li], d_li, n_data,
+                            data_axis, local_data=streaming)
+                        ms_levels.append(ms_l)
+                        spec_l = lay["specs"][rate]
+                        sf, cf = spec_l.flatten(s_l), spec_l.flatten(c_l)
+                        if self._codec_map[rate] == "dense":
+                            payload[f"L{li}"] = (sf, cf)
+                            continue
+                        cobj = self._map_codec(rate, spec_l)
+                        off = lay["offsets"][rate]
+                        rs_l = jax.lax.dynamic_slice(
+                            rs, (0, off),
+                            (2, spec_l.total))[:cobj.resid_slots]
+                        sub_l = extract_sliced_jnp(
+                            p, gm.specs, gm.groups, rate / self.global_rate)
+                        pl, nr_l = cobj.encode(sf, cf, rs_l, sub_l, key,
+                                               per_dev)
+                        payload[f"L{li}"] = pl
+                        dec[li] = (cobj, sub_l, nr_l, off)
+                    ms = {n: jnp.stack([m[n] for m in ms_levels])
+                          for n in ms_levels[0]}
+                    # THE single global psum: one bind joins every level's
+                    # payload (a pytree psum is one bind; staticcheck holds
+                    # the summed operand bytes to the per-level-map budget)
+                    agg = jax.lax.psum(payload, "clients")
+                    tot_s = tot_c = None
+                    nr = jnp.zeros_like(rs)
+                    for li, rate in enumerate(level_rates):
+                        spec_l = lay["specs"][rate]
+                        if li in dec:
+                            cobj, sub_l, nr_l, off = dec[li]
+                            sf, cf = cobj.decode(agg[f"L{li}"], sub_l, key,
+                                                 per_dev)
+                            nr = jax.lax.dynamic_update_slice(nr, nr_l,
+                                                              (0, off))
+                        else:
+                            sf, cf = agg[f"L{li}"]
+                        s_e = embed(spec_l.unflatten(sf), rate)
+                        c_e = embed(spec_l.unflatten(cf), rate)
+                        tot_s = s_e if tot_s is None else \
+                            {n: tot_s[n] + s_e[n] for n in tot_s}
+                        tot_c = c_e if tot_c is None else \
+                            {n: tot_c[n] + c_e[n] for n in tot_c}
+                    new_p = combine_counted(p, tot_s, tot_c)
+                    return (new_p, nr), ms
                 if mode == "span":
                     # srow: [L, per_dev] -- this device's slots of EVERY level
                     tot_s = tot_c = None
@@ -587,30 +777,51 @@ class GroupedRoundEngine(_WireCodecCarry):
                     # invariant, audited by staticcheck): one bind joins the
                     # level sums AND counts across the whole clients axis
                     tot_s, tot_c = jax.lax.psum((tot_s, tot_c), "clients")
+                if buffered:
+                    # buffered-async aggregation (ISSUE 9): this round's
+                    # reduction lands NEXT round, staleness-weighted; the
+                    # previous round's buffered update applies now
+                    new_p, nb = buffered_combine(p, sb, tot_s, tot_c,
+                                                 FlatSpec.of(p),
+                                                 self._sched_spec.staleness)
+                    return (new_p, nb), ms
                 new_p = combine_counted(p, tot_s, tot_c)
                 return ((new_p, nr) if codec else new_p), ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
             xs = (epochs, sched) + (tuple(sdata) if streaming else ())
-            carry0 = (params, resid0[0]) if codec else params
+            if codec:
+                carry0 = (params, resid0[0])
+            elif buffered:
+                carry0 = (params, buf0)
+            else:
+                carry0 = params
+
+            def unpack(carry):
+                if codec:
+                    return carry[0], (carry[1][None],)
+                if buffered:
+                    return carry[0], (carry[1],)
+                return carry, ()
+
             if groups is None:
                 carry, ms = jax.lax.scan(step, carry0, xs)
-                if codec:
-                    return carry[0], carry[1][None], ms
-                return carry, ms
+                p_out, extra = unpack(carry)
+                return (p_out,) + extra + (ms,)
             # eval runs on the combined globals AFTER the round(s) it
             # follows, outside the slices-mode switch; the shared walk keeps
             # it at the program's top level (bit-identical-to-host contract)
             carry, ms, ev = eval_fused_scan(
                 step, carry0, xs, epochs, groups, fused_eval, eval_ops,
-                params_of=(lambda c: c[0]) if codec else None)
-            if codec:
-                return carry[0], carry[1][None], ms, ev
-            return carry, ms, ev
+                params_of=(lambda c: c[0]) if (codec or buffered) else None)
+            p_out, extra = unpack(carry)
+            return (p_out,) + extra + (ms, ev)
 
         lr_specs = (P(),) if lr_arg else ()
         eval_specs = tuple(fused_eval.specs) if groups else ()
         resid_specs = (P("clients"),) if codec else ()
+        buf_specs = (P(),) if buffered else ()
+        carry_specs = resid_specs + buf_specs  # mutually exclusive
         sched_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
         if streaming:
             # cohort stacks ride the xs in the schedule's own slot layout
@@ -618,25 +829,26 @@ class GroupedRoundEngine(_WireCodecCarry):
         else:
             data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
         ms_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
-        out_specs = (P(),) + resid_specs + (ms_spec,)
+        out_specs = (P(),) + carry_specs + (ms_spec,)
         if groups is not None:
             out_specs = out_specs + (fused_eval.out_specs,)
         fn = _shard_map(
             sbody, mesh,
-            in_specs=(P(),) + resid_specs + (P(), P()) + lr_specs
+            in_specs=(P(),) + carry_specs + (P(), P()) + lr_specs
             + (sched_spec,) + data_specs + eval_specs,
             out_specs=out_specs,
         )
-        # Codec programs donate ONLY the resid carry, not the params carry:
-        # donating the replicated params here trips an XLA:CPU executable-
-        # serialization bug (jaxlib 0.4.36) where the program reloaded from
-        # the persistent compile cache mis-assigns the params-sized resid
-        # OUTPUT buffer and returns nondeterministic garbage on a stable
-        # subset of its elements (fresh compiles are correct; caught by
-        # test_resid_checkpoint_roundtrip_grouped on a warm cache).  Cost:
-        # one extra params-size buffer in lossy-codec grouped supersteps,
+        # Codec/buffered programs donate ONLY their extra carry, not the
+        # params carry: donating the replicated params here trips an
+        # XLA:CPU executable-serialization bug (jaxlib 0.4.36) where the
+        # program reloaded from the persistent compile cache mis-assigns
+        # the params-sized extra OUTPUT buffer and returns nondeterministic
+        # garbage on a stable subset of its elements (fresh compiles are
+        # correct; caught by test_resid_checkpoint_roundtrip_grouped on a
+        # warm cache).  Cost: one extra params-size buffer per dispatch,
         # priced into the staticcheck HBM budgets.
-        prog = jax.jit(fn, donate_argnums=(1,) if codec else (0,))
+        prog = jax.jit(fn, donate_argnums=(1,) if (codec or buffered)
+                       else (0,))
         self._superstep_progs[key_] = prog
         return prog
 
@@ -805,8 +1017,7 @@ class GroupedRoundEngine(_WireCodecCarry):
                 eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
                 epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
                 global_params = self._staging.commit(self._pin(global_params))
-                resid_args = () if self._codec_name == "dense" \
-                    else (self._ensure_resid(global_params),)
+                carry_args = self._carry_args(global_params)
                 prog = self._superstep_prog(k, per_dev, mode,
                                             eval_mask=eval_mask,
                                             fused_eval=fused_eval,
@@ -843,19 +1054,23 @@ class GroupedRoundEngine(_WireCodecCarry):
                 epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
                 # commit the params carry (see train_round), layout pinned
                 global_params = self._staging.commit(self._pin(global_params))
-                resid_args = () if self._codec_name == "dense" \
-                    else (self._ensure_resid(global_params),)
+                carry_args = self._carry_args(global_params)
                 prog = self._superstep_prog(k, per_dev, mode,
                                             eval_mask=eval_mask,
                                             fused_eval=fused_eval,
                                             lr_arg=lr_arg)
         with timer.phase("dispatch"):
-            out = prog(global_params, *resid_args, base_key, epoch0_dev,
+            out = prog(global_params, *carry_args, base_key, epoch0_dev,
                        *lr_args, sched_dev, *args, *eval_args)
         if self._codec_name != "dense":
             # stash the new error-feedback carry (checkpointed via
             # wire_resid_host / set_wire_resid at superstep boundaries)
             self._resid = out[1]
+            out = (out[0],) + out[2:]
+        elif self._sched_spec.buffered:
+            # stash the new staleness buffer (checkpointed via
+            # sched_buf_host / set_sched_buf at superstep boundaries)
+            self._sched_buf = out[1]
             out = (out[0],) + out[2:]
 
         def _assemble_train(host):

@@ -39,6 +39,9 @@ from ..compress import make_codec, resid_slots, resolve_codec_cfg
 from ..config import resolve_prefetch_depth
 from ..data.datasets import DATASET_STATS
 from ..fed.core import combine_counted, round_rates, round_users
+from ..sched import resolve_schedule_cfg
+from ..sched.buffer import _SchedBufCarry, buffered_combine
+from ..sched.deadline import deadline_steps
 from .ring_attention import ring_attention
 from .staging import (ClientStore, CohortStager, PendingMetrics, PhaseTimer,
                       PlacementCache, SlotPacker, StagedCohort)
@@ -273,8 +276,21 @@ class _WireCodecCarry:
         self._resid = jax.jit(lambda t: t + 0, out_shardings=sh)(
             jax.device_put(host, sh))
 
+    def _carry_args(self, params) -> Tuple:
+        """The round/superstep programs' extra donated carry argument: the
+        wire-codec EF residual (ISSUE 8) or the buffered-async staleness
+        buffer (ISSUE 9, :class:`~..sched.buffer._SchedBufCarry` -- both
+        engines mix the two carries in together); empty under dense sync
+        lockstep, the zero-new-args contract.  The two carries are mutually
+        exclusive (validated at engine construction)."""
+        if self._codec_name != "dense":
+            return (self._ensure_resid(params),)
+        if self._sched_spec.buffered:
+            return (self._ensure_sched_buf(params),)
+        return ()
 
-class RoundEngine(_WireCodecCarry):
+
+class RoundEngine(_WireCodecCarry, _SchedBufCarry):
     """Jitted train/eval/sBN programs for one (model, cfg, mesh) triple.
 
     Shapes are taken from the arrays passed in; jit re-specialises on new
@@ -325,8 +341,23 @@ class RoundEngine(_WireCodecCarry):
         # the error-feedback residual as an extra donated carry.  'dense'
         # keeps today's program bit for bit (no new args, no residual).
         self._codec_name, self._error_feedback = resolve_codec_cfg(cfg)
+        if isinstance(self._codec_name, dict):
+            # per-level maps belong to the grouped engine's fused superstep;
+            # this engine may still be CONSTRUCTED (the driver always builds
+            # its default-engine slot), so the refusal fires at dispatch
+            self._codec_name = "__per-level-map__"
         self._codec_obj = None  # built lazily (needs the param shapes)
         self._resid = None      # device [n_dev, slots, total] EF carry
+        # scheduler (ISSUE 9, heterofl_tpu/sched/): availability schedule +
+        # deadline stragglers + buffered-async aggregation.  The lockstep
+        # default builds byte-identical programs (zero new carry args).
+        self._sched_spec = resolve_schedule_cfg(cfg)
+        self._sched_buf = None  # device [2, total] staleness carry
+        if self._sched_spec.buffered and self._codec_name != "dense":
+            raise ValueError(
+                "schedule aggregation='buffered' cannot combine with a "
+                "lossy wire_codec yet: both add a scan carry with its own "
+                "donation/checkpoint contract -- pick one per experiment")
         self._train = None
         self._superstep_progs: Dict[Tuple, Any] = {}
         self._lr_fn = None  # built on first superstep (plateau raises there)
@@ -345,6 +376,16 @@ class RoundEngine(_WireCodecCarry):
         # deeper pipelines once per-superstep compute shrinks on real TPUs)
         self._cohort_stager = None
         self._prefetch_depth = resolve_prefetch_depth(cfg)
+
+    def _reject_per_level_map(self):
+        """A per-level wire_codec map (ISSUE 9 satellite) only exists on
+        the grouped engine's fused superstep; dispatching the masked engine
+        under one is a config error, refused loudly here."""
+        if self._codec_name == "__per-level-map__":
+            raise ValueError(
+                "a per-level wire_codec map needs the grouped strategy "
+                "(its fused superstep owns per-level payloads); the masked "
+                "engine has no levels to assign codecs to")
 
     # ------------------------------------------------------------------
     # per-client local training (pure; vmapped across clients)
@@ -432,7 +473,7 @@ class RoundEngine(_WireCodecCarry):
         return p_new, opt_new
 
     def _local_train_vision(self, params, wr, x, y, sm, lm, key, lr, scaler_rate=None,
-                            data_axis=None, n_data: int = 1):
+                            data_axis=None, n_data: int = 1, step_limit=None):
         """Local SGD for one client.
 
         ``data_axis``/``n_data``: intra-client batch data-parallelism -- each
@@ -440,6 +481,12 @@ class RoundEngine(_WireCodecCarry):
         gradients/metrics are ``psum``-ed and BN runs synchronised, so the
         result is numerically identical to single-device execution (modulo
         augmentation RNG).  Callers outside ``shard_map`` pass ``None``.
+
+        ``step_limit`` (ISSUE 9 deadline): this client's local-step budget
+        (traced int32); steps at index >= the budget gate off the optimizer
+        update AND their metric contributions -- truncated training, pure
+        in-scan arithmetic.  ``None`` (the lockstep default) leaves the
+        step body byte-identical to the pre-scheduler program.
         """
         model, B, E = self.model, self.batch_size, self.local_epochs
         N = x.shape[0]
@@ -477,6 +524,12 @@ class RoundEngine(_WireCodecCarry):
             w = jax.lax.dynamic_slice(wpad, (s * B,), (B,)) * sm[ids]
             has = (jnp.sum(w) > 0)  # global batch weight BEFORE any sharding
             n_glob = jnp.sum(w)
+            live = None
+            if step_limit is not None:
+                # deadline straggler (ISSUE 9): steps past this client's
+                # budget are no-ops -- update skipped, metrics zeroed
+                live = t < step_limit
+                has = jnp.logical_and(has, live)
             aug_key = jax.random.fold_in(key, 2 + t)
             if data_axis is not None and n_data > 1:
                 # this device's slice of the client's batch, with the
@@ -510,6 +563,9 @@ class RoundEngine(_WireCodecCarry):
                 grads, lsum, correct = jax.lax.psum((grads, lsum, correct), data_axis)
             p, opt = self._apply_update(p, grads, opt, emasks, spec, wr,
                                         n_glob, lr, has=has)
+            if live is not None:
+                g = live.astype(jnp.float32)
+                lsum, correct, n_glob = lsum * g, correct * g, n_glob * g
             acc = (acc[0] + lsum, acc[1] + correct, acc[2] + n_glob)
             return (p, opt, acc), None
 
@@ -521,8 +577,12 @@ class RoundEngine(_WireCodecCarry):
         return p, {"loss_sum": acc[0], "score_sum": acc[1], "n": acc[2]}
 
     def _local_train_lm(self, params, wr, rows, lm, key, lr, scaler_rate=None,
-                        data_axis=None, n_data: int = 1):
+                        data_axis=None, n_data: int = 1, step_limit=None):
         """Local SGD on one client's token rows.
+
+        ``step_limit`` (ISSUE 9 deadline): per-client local-step budget --
+        same truncation semantics as :meth:`_local_train_vision` (None =
+        byte-identical lockstep body).
 
         ``data_axis``/``n_data``: sequence parallelism -- each device on that
         mesh axis holds ``bptt/n_data`` positions of every window, attention
@@ -581,11 +641,14 @@ class RoundEngine(_WireCodecCarry):
             else:
                 n_glob = n_loc
             loss = lsum / jnp.maximum(n_glob, 1e-6)
+            live = None if step_limit is None else (t < step_limit)
             p, opt = self._apply_update(p, grads, opt, emasks, spec, wr,
-                                        n_glob, lr)
+                                        n_glob, lr, has=live)
             # Logger weight: rows per window (ref train_transformer_fed.py
             # appends with input['label'].size(0)); Perplexity = exp(window CE).
             n = np.float32(R)  # static trace-time constant, not a device wrap
+            if live is not None:
+                n = n * live.astype(jnp.float32)  # deadline: truncated steps
             acc = (acc[0] + loss * n, acc[1] + jnp.exp(loss) * n, acc[2] + n)
             return (p, opt, acc), None
 
@@ -601,7 +664,7 @@ class RoundEngine(_WireCodecCarry):
     # ------------------------------------------------------------------
 
     def _round_core(self, params, key, lr, user_loc, user_glob, data,
-                    resid=None):
+                    resid=None, sched_buf=None):
         """One round's in-jit core, per device (runs inside ``shard_map``):
         slot training + counted-average ``psum``.  Shared by the one-round
         program (:meth:`_build_train`) and the K-round superstep scan
@@ -617,8 +680,12 @@ class RoundEngine(_WireCodecCarry):
         mesh-shape-invariant.  -1 = padding slot.  ``data`` carries the
         fix-rates table as its last element in fix mode.  ``resid``: this
         device's ``[slots, total]`` error-feedback carry (lossy wire codecs
-        only; None under dense).  Returns ``(new_params, metric sums,
-        new_resid-or-None)``."""
+        only; None under dense).  ``sched_buf``: the replicated ``[2,
+        total]`` staleness carry (buffered-async aggregation only, ISSUE 9;
+        the previous round's reduced sums/counts apply here one round late
+        while this cohort's reduction is buffered for the next).  Returns
+        ``(new_params, metric sums, new_resid-or-None,
+        new_sched_buf-or-None)``."""
         model, cfg, mesh = self.model, self.cfg, self.mesh
         dynamic = cfg["model_split_mode"] == "dynamic"
         # staticcheck: allow(no-float-coercion): trace-time config scalar
@@ -650,11 +717,26 @@ class RoundEngine(_WireCodecCarry):
             rows = all_rows if uidx is None else all_rows[uidx]
             lm = all_lm if uidx is None else all_lm[uidx]
             n_data = mesh.shape["data"]
-            trained, ms = jax.vmap(
-                lambda w_, r_, l_, k_: self._local_train_lm(
-                    params, w_, r_, l_, k_, lr,
-                    data_axis="data" if n_data > 1 else None, n_data=n_data)
-            )(wr, rows, lm, slot_keys)
+            if self._sched_spec.has_deadline:
+                # deadline stragglers (ISSUE 9): per-client step budgets
+                # from the shared (round key, uid) stream -- the grouped
+                # engine draws the identical budgets in _level_core
+                total_steps = self.local_epochs * _ceil_div(
+                    int(rows.shape[-1]), self.bptt)
+                limits = deadline_steps(key, ugid, total_steps,
+                                        self._sched_spec.deadline_min_frac)
+                trained, ms = jax.vmap(
+                    lambda w_, r_, l_, k_, lim_: self._local_train_lm(
+                        params, w_, r_, l_, k_, lr,
+                        data_axis="data" if n_data > 1 else None,
+                        n_data=n_data, step_limit=lim_)
+                )(wr, rows, lm, slot_keys, limits)
+            else:
+                trained, ms = jax.vmap(
+                    lambda w_, r_, l_, k_: self._local_train_lm(
+                        params, w_, r_, l_, k_, lr,
+                        data_axis="data" if n_data > 1 else None, n_data=n_data)
+                )(wr, rows, lm, slot_keys)
         else:
             all_x, all_y, all_m, all_lm = data[0], data[1], data[2], data[3]
             if uidx is None:
@@ -662,11 +744,23 @@ class RoundEngine(_WireCodecCarry):
             else:
                 xs, ys, sms, lm = all_x[uidx], all_y[uidx], all_m[uidx], all_lm[uidx]
             n_data = mesh.shape["data"]
-            trained, ms = jax.vmap(
-                lambda w_, x_, y_, m_, l_, k_: self._local_train_vision(
-                    params, w_, x_, y_, m_, l_, k_, lr,
-                    data_axis="data" if n_data > 1 else None, n_data=n_data)
-            )(wr, xs, ys, sms, lm, slot_keys)
+            if self._sched_spec.has_deadline:
+                total_steps = self.local_epochs * _ceil_div(
+                    int(xs.shape[1]), self.batch_size)
+                limits = deadline_steps(key, ugid, total_steps,
+                                        self._sched_spec.deadline_min_frac)
+                trained, ms = jax.vmap(
+                    lambda w_, x_, y_, m_, l_, k_, lim_: self._local_train_vision(
+                        params, w_, x_, y_, m_, l_, k_, lr,
+                        data_axis="data" if n_data > 1 else None,
+                        n_data=n_data, step_limit=lim_)
+                )(wr, xs, ys, sms, lm, slot_keys, limits)
+            else:
+                trained, ms = jax.vmap(
+                    lambda w_, x_, y_, m_, l_, k_: self._local_train_vision(
+                        params, w_, x_, y_, m_, l_, k_, lr,
+                        data_axis="data" if n_data > 1 else None, n_data=n_data)
+                )(wr, xs, ys, sms, lm, slot_keys)
 
         shapes = {k: v.shape for k, v in params.items()}
         cms = jax.vmap(lambda w_, l_, v_: jax.tree_util.tree_map(
@@ -693,10 +787,20 @@ class RoundEngine(_WireCodecCarry):
             summed, counts, new_resid = compressed_psum(
                 codec, "clients", params, summed, counts, resid, key,
                 int(user_glob.shape[0]))
-        new_params = combine_counted(params, summed, counts)
+        if self._sched_spec.buffered:
+            # buffered-async aggregation (ISSUE 9): this cohort's reduction
+            # lands NEXT round (staleness-weighted); the previous round's
+            # buffered update applies now.  The single-psum wire contract
+            # is untouched -- buffering happens after the reduction.
+            new_params, new_buf = buffered_combine(
+                params, sched_buf, summed, counts, FlatSpec.of(params),
+                self._sched_spec.staleness)
+        else:
+            new_params = combine_counted(params, summed, counts)
+            new_buf = None
         ms = {k: v * valid for k, v in ms.items()}
         ms["rate"] = rates_abs * valid
-        return new_params, ms, new_resid
+        return new_params, ms, new_resid, new_buf
 
     def _data_specs(self) -> Tuple[P, ...]:
         """shard_map in_specs of the ``data`` tuple (incl. the fix-rates
@@ -716,8 +820,8 @@ class RoundEngine(_WireCodecCarry):
             # compressed round (ISSUE 8): the EF residual is an extra
             # donated carry -- [1, slots, total] per device in, same out
             def body(params, resid, key, lr, user_loc, user_glob, *data):
-                p, ms, r = self._round_core(params, key, lr, user_loc,
-                                            user_glob, data, resid=resid[0])
+                p, ms, r, _ = self._round_core(params, key, lr, user_loc,
+                                               user_glob, data, resid=resid[0])
                 return p, r[None], ms
 
             fn = _shard_map(
@@ -731,9 +835,30 @@ class RoundEngine(_WireCodecCarry):
             # bug (see _WireCodecCarry) -- both engines pin the same policy
             return jax.jit(fn, donate_argnums=(1,))
 
+        if self._sched_spec.buffered:
+            # buffered-async round (ISSUE 9): the staleness buffer is an
+            # extra donated carry -- replicated [2, total] in, same out
+            def body(params, buf, key, lr, user_loc, user_glob, *data):
+                p, ms, _, nb = self._round_core(params, key, lr, user_loc,
+                                                user_glob, data,
+                                                sched_buf=buf)
+                return p, nb, ms
+
+            fn = _shard_map(
+                body, self.mesh,
+                in_specs=(P(), P(), P(), P(), P("clients"),
+                          P("clients")) + self._data_specs(),
+                out_specs=(P(), P(), P("clients")),
+            )
+            # buf-only donation: donating the params carry alongside a
+            # params-sized buffer output is the trigger pattern of the
+            # XLA:CPU executable-serialization bug (see _WireCodecCarry /
+            # _SchedBufCarry) -- same policy as the codec programs
+            return jax.jit(fn, donate_argnums=(1,))
+
         def body(params, key, lr, user_loc, user_glob, *data):
-            p, ms, _ = self._round_core(params, key, lr, user_loc, user_glob,
-                                        data)
+            p, ms, _, _ = self._round_core(params, key, lr, user_loc,
+                                           user_glob, data)
             return p, ms
 
         fn = _shard_map(
@@ -797,17 +922,32 @@ class RoundEngine(_WireCodecCarry):
         if groups is not None and not any(ev for _, ev, _ in groups):
             groups = None  # an all-False mask is the plain train superstep
         codec = self._codec_name != "dense"
+        buffered = self._sched_spec.buffered
+        # in-jit availability sampling (ISSUE 9): only the eager replicated
+        # path samples inside the scan -- a non-uniform schedule threads its
+        # [T, U] trace in as a replicated program argument there; every
+        # host-schedule path (sharded/streaming/grouped) consumes the trace
+        # through fed.core.superstep_user_schedule instead
+        trace_arg = bool(in_jit and not streaming
+                         and self._sched_spec.kind != "uniform")
 
         def sbody(params, *all_rest):
             if codec:
                 # wire codec (ISSUE 8): the EF residual joins the scan carry
                 resid0, base_key, epoch0, *rest = all_rest
+            elif buffered:
+                # buffered-async aggregation (ISSUE 9): the staleness buffer
+                # joins the scan carry
+                buf0, base_key, epoch0, *rest = all_rest
             else:
                 base_key, epoch0, *rest = all_rest
             idx = 0
-            if lr_arg:
-                lr_const = rest[0]
+            if trace_arg:
+                trace = rest[0]
                 idx = 1
+            if lr_arg:
+                lr_const = rest[idx]
+                idx += 1
             if streaming:
                 sched_ug = rest[idx]
                 idx += 1
@@ -824,20 +964,44 @@ class RoundEngine(_WireCodecCarry):
                 eval_ops = rest[idx + n_data_args:]
 
             def step(carry, xs):
-                p, rs = carry if codec else (carry, None)
+                if codec:
+                    p, rs, sb = carry[0], carry[1], None
+                elif buffered:
+                    p, rs, sb = carry[0], None, carry[1]
+                else:
+                    p, rs, sb = carry, None, None
+
+                def pack(new_p, nr, nb):
+                    if codec:
+                        return (new_p, nr)
+                    if buffered:
+                        return (new_p, nb)
+                    return new_p
+
                 if streaming:
                     t, ug, *d = xs
                     key = jax.random.fold_in(base_key, t)
                     lr = lr_const if lr_arg else lr_fn(t)
                     # slot-local cohort rows: user_loc=None = identity gather
-                    new_p, ms, nr = self._round_core(
+                    new_p, ms, nr, nb = self._round_core(
                         p, key, lr, None, ug, tuple(d) + tuple(fix),
-                        resid=rs)
-                    return ((new_p, nr) if codec else new_p), ms
+                        resid=rs, sched_buf=sb)
+                    return pack(new_p, nr, nb), ms
                 if in_jit:
                     (t,) = xs
                     key = jax.random.fold_in(base_key, t)
-                    active = round_users(key, num_users, num_active)
+                    if trace_arg:
+                        # availability-trace sampling (ISSUE 9): round t's
+                        # 0/1 row gates the shared sampling stream; slots
+                        # the availability cannot fill come back -1
+                        # (padding).  (t - 1) % T is the host twin's index
+                        # (ScheduleSpec.avail_row), shared by construction.
+                        row = jnp.take(trace, (t - 1) % trace.shape[0],
+                                       axis=0)
+                        active = round_users(key, num_users, num_active,
+                                             avail=row)
+                    else:
+                        active = round_users(key, num_users, num_active)
                     pad = jnp.full((slots_total - num_active,), -1, jnp.int32)
                     padded = jnp.concatenate([active, pad])
                     d = jax.lax.axis_index("clients")
@@ -847,46 +1011,61 @@ class RoundEngine(_WireCodecCarry):
                     t, ul, ug = xs
                     key = jax.random.fold_in(base_key, t)
                 lr = lr_const if lr_arg else lr_fn(t)
-                new_p, ms, nr = self._round_core(p, key, lr, ul, ug, data,
-                                                 resid=rs)
-                return ((new_p, nr) if codec else new_p), ms
+                new_p, ms, nr, nb = self._round_core(p, key, lr, ul, ug, data,
+                                                     resid=rs, sched_buf=sb)
+                return pack(new_p, nr, nb), ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
             if streaming:
                 xs = (epochs, sched_ug) + tuple(sdata)
             else:
                 xs = (epochs,) if in_jit else (epochs, sched_ul, sched_ug)
-            carry0 = (params, resid0[0]) if codec else params
+            if codec:
+                carry0 = (params, resid0[0])
+            elif buffered:
+                carry0 = (params, buf0)
+            else:
+                carry0 = params
+
+            def unpack(carry):
+                if codec:
+                    return carry[0], (carry[1][None],)
+                if buffered:
+                    return carry[0], (carry[1],)
+                return carry, ()
+
             if groups is None:
                 carry, ms = jax.lax.scan(step, carry0, xs)
-                if codec:
-                    return carry[0], carry[1][None], ms
-                return carry, ms
+                p_out, extra = unpack(carry)
+                return (p_out,) + extra + (ms,)
             carry, ms, ev = eval_fused_scan(
                 step, carry0, xs, epochs, groups, fused_eval, eval_ops,
-                params_of=(lambda c: c[0]) if codec else None)
-            if codec:
-                return carry[0], carry[1][None], ms, ev
-            return carry, ms, ev
+                params_of=(lambda c: c[0]) if (codec or buffered) else None)
+            p_out, extra = unpack(carry)
+            return (p_out,) + extra + (ms, ev)
 
         lr_specs = (P(),) if lr_arg else ()
+        trace_specs = (P(),) if trace_arg else ()
         eval_specs = tuple(fused_eval.specs) if groups else ()
         resid_specs = (P("clients"),) if codec else ()
-        out_specs = (P(),) + resid_specs + (P(None, "clients"),)
+        buf_specs = (P(),) if buffered else ()
+        carry_specs = resid_specs + buf_specs  # mutually exclusive
+        out_specs = (P(),) + carry_specs + (P(None, "clients"),)
         if groups is not None:
             out_specs = out_specs + (fused_eval.out_specs,)
         fn = _shard_map(
             sbody, mesh,
-            in_specs=(P(),) + resid_specs + (P(), P()) + lr_specs
-            + sched_specs + data_specs + eval_specs,
+            in_specs=(P(),) + carry_specs + (P(), P()) + trace_specs
+            + lr_specs + sched_specs + data_specs + eval_specs,
             out_specs=out_specs,
         )
-        # codec programs donate ONLY the resid carry (see _WireCodecCarry:
-        # params donation + a params-sized resid output trips an XLA:CPU
-        # executable-serialization bug when reloaded from the persistent
-        # compile cache; caught by the masked signsgd checkpoint round-trip
-        # on a warm cache)
-        return jax.jit(fn, donate_argnums=(1,) if codec else (0,))
+        # codec/buffered programs donate ONLY their extra carry (see
+        # _WireCodecCarry: params donation + a params-sized extra output
+        # trips an XLA:CPU executable-serialization bug when reloaded from
+        # the persistent compile cache; caught by the masked signsgd
+        # checkpoint round-trip on a warm cache)
+        return jax.jit(fn, donate_argnums=(1,) if (codec or buffered)
+                       else (0,))
 
     def stage_cohort(self, store: ClientStore, user_schedule,
                      timer: PhaseTimer = None) -> StagedCohort:
@@ -984,6 +1163,7 @@ class RoundEngine(_WireCodecCarry):
         shards ride the scan xs and the program never sees the population.
         The slot layout and sampling stream match the in-jit draw, so a
         streamed superstep is bit-identical to the eager one."""
+        self._reject_per_level_map()
         eval_mask = normalize_eval_mask(eval_mask, k, fused_eval)
         lr_arg = lr is not None
         if not lr_arg and self._lr_fn is None:
@@ -1005,8 +1185,7 @@ class RoundEngine(_WireCodecCarry):
                 eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
                 epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
                 params = self._staging.commit(self._pin(params))
-                resid_args = () if self._codec_name == "dense" \
-                    else (self._ensure_resid(params),)
+                carry_args = self._carry_args(params)
                 pkey = (k, per_dev, "stream", a, eval_mask, lr_arg)
                 prog = self._superstep_progs.get(pkey)
                 if prog is None:
@@ -1017,7 +1196,7 @@ class RoundEngine(_WireCodecCarry):
                                                  lr_arg=lr_arg, streaming=True)
                     self._superstep_progs[pkey] = prog
             with timer.phase("dispatch"):
-                out = prog(params, *resid_args, base_key, epoch0_dev,
+                out = prog(params, *carry_args, base_key, epoch0_dev,
                            *lr_args, *sched_args, *args, *eval_args)
             return self._assemble_superstep(out, epoch0, k, eval_mask,
                                             fused_eval)
@@ -1090,8 +1269,15 @@ class RoundEngine(_WireCodecCarry):
             # outputs come back mesh-committed (staticcheck recompile audit);
             # the layout pin rides the same commit (models/layout.py policy)
             params = self._staging.commit(self._pin(params))
-            resid_args = () if self._codec_name == "dense" \
-                else (self._ensure_resid(params),)
+            carry_args = self._carry_args(params)
+            trace_args = ()
+            if in_jit and self._sched_spec.kind != "uniform":
+                # the availability trace enters the in-jit sampling program
+                # as a committed replicated argument (ISSUE 9); the cached
+                # property returns one host array, so this commit is a
+                # steady-state identity hit
+                trace_args = self._staging.replicated(
+                    "sched_trace", (self._sched_spec.trace,))
             pkey = (k, per_dev, in_jit, a, eval_mask, lr_arg)
             prog = self._superstep_progs.get(pkey)
             if prog is None:
@@ -1101,19 +1287,25 @@ class RoundEngine(_WireCodecCarry):
                                              lr_arg=lr_arg)
                 self._superstep_progs[pkey] = prog
         with timer.phase("dispatch"):
-            out = prog(params, *resid_args, base_key, epoch0_dev, *lr_args,
-                       *sched_args, *args, *eval_args)
+            out = prog(params, *carry_args, base_key, epoch0_dev,
+                       *trace_args, *lr_args, *sched_args, *args, *eval_args)
         return self._assemble_superstep(out, epoch0, k, eval_mask, fused_eval)
 
     def _assemble_superstep(self, out, epoch0: int, k: int, eval_mask,
                             fused_eval):
         """Package one superstep dispatch's outputs: ``(new_params,
         PendingMetrics)``; shared by the eager and streaming paths.  Under a
-        lossy wire codec the second output is the new error-feedback carry,
-        stashed on the engine (read/restored via :meth:`wire_resid_host` /
-        :meth:`set_wire_resid` at checkpoint boundaries)."""
+        lossy wire codec the second output is the new error-feedback carry;
+        under buffered-async aggregation it is the new staleness buffer --
+        either way stashed on the engine (read/restored via
+        :meth:`wire_resid_host`/:meth:`set_wire_resid` or
+        :meth:`~..sched.buffer._SchedBufCarry.sched_buf_host`/
+        :meth:`set_sched_buf` at checkpoint boundaries)."""
         if self._codec_name != "dense":
             self._resid = out[1]
+            out = (out[0],) + out[2:]
+        elif self._sched_spec.buffered:
+            self._sched_buf = out[1]
             out = (out[0],) + out[2:]
         if eval_mask is None:
             new_params, ms = out
@@ -1159,6 +1351,7 @@ class RoundEngine(_WireCodecCarry):
         accounts the stage/dispatch phases.  Returns ``(new_params,
         per-client metric sums)`` with the metric sums still on device.
         """
+        self._reject_per_level_map()
         if self._train is None:
             self._train = self._build_train()
         timer = timer if timer is not None else PhaseTimer()
@@ -1201,11 +1394,14 @@ class RoundEngine(_WireCodecCarry):
             # program specialization (see train_superstep); layout pinned
             # by the same policy
             params = self._staging.commit(self._pin(params))
-            resid_args = () if self._codec_name == "dense" \
-                else (self._ensure_resid(params),)
+            carry_args = self._carry_args(params)
         with timer.phase("dispatch"):
             if self._codec_name != "dense":
                 new_p, self._resid, ms = self._train(
-                    params, *resid_args, key, lr, ul, ug, *args)
+                    params, *carry_args, key, lr, ul, ug, *args)
+                return new_p, ms
+            if self._sched_spec.buffered:
+                new_p, self._sched_buf, ms = self._train(
+                    params, *carry_args, key, lr, ul, ug, *args)
                 return new_p, ms
             return self._train(params, key, lr, ul, ug, *args)

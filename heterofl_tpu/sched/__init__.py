@@ -1,0 +1,257 @@
+"""Client scheduler (ISSUE 9 tentpole): who trains, for how long, and when
+their update lands.
+
+HeteroFL's simulation samples a fixed fraction of clients each round and
+marches every survivor in lockstep -- the one scenario axis the paper never
+varies.  Real federated deployments are dominated by partial availability,
+stragglers and asynchrony (PAPERS.md 2405.20431, the practicality survey;
+2308.11953 frames staleness-weighted updates).  This package owns the three
+scheduling mechanisms, all of which run INSIDE the engines' fused K-round
+scan:
+
+* **who** -- replayable availability-trace sampling: a pluggable schedule
+  behind :func:`~..fed.core.round_users`.  ``uniform`` (default) is
+  today's permutation draw, bit for bit.  ``trace`` replays a recorded
+  ``[T, U]`` 0/1 availability matrix (rounds cycle through the rows);
+  ``markov`` generates such a trace from a seeded per-client on/off chain
+  (:func:`markov_trace`) and then IS a trace -- deterministic, so a run
+  (and a checkpoint resume) reproduces identical cohorts, and the
+  streaming prefetch pipeline keeps overlapping (the schedule never
+  depends on round outputs).  Unavailable slots surface as ``-1`` ids,
+  which the engines already treat as padding -- a short round degrades to
+  partial participation instead of resampling.
+* **for how long** -- deadline-based partial participation: each active
+  client draws a per-round local-step budget
+  (:func:`~.deadline.deadline_steps`, seeded by ``(round key, user id)``
+  so both engines draw identically) and steps past the budget are masked
+  out IN the local-step scan -- pure in-scan arithmetic on the masked
+  engine, per-level masks on the grouped one.  A slow client contributes
+  truncated training instead of dropping (generalising the all-or-nothing
+  ``client_failure_rate`` injection).
+* **when it lands** -- buffered asynchronous aggregation: with
+  ``aggregation='buffered'`` the server applies cohort k's update while
+  cohort k+1 trains -- a second scan-carry buffer holds the previous
+  round's ``(sums, counts)`` and is applied one round late with a
+  staleness-discounted mixing weight (:func:`staleness_weight`).  The
+  buffer is checkpointed at superstep boundaries exactly like the
+  wire-codec error-feedback residual (:mod:`.buffer`).
+
+Contracts: the lockstep default (``cfg['schedule']=None``) adds ZERO new
+program arguments and stays bit-identical to the pre-scheduler engines;
+deadline and buffered modes pin superstep == sequential with the buffer
+carried bit for bit (tests/test_sched.py) and record accuracy-vs-lockstep
+in MEASUREMENTS.md instead of silently weakening the dense contracts.
+
+This module is import-light (numpy only): config validation and the
+analytic staleness weight live here; the jax halves are in
+:mod:`.deadline` and :mod:`.buffer`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+#: the schedule registry (``cfg['schedule']['kind']``)
+SCHEDULE_KINDS = ("uniform", "trace", "markov")
+
+#: when a cohort's update lands (``cfg['schedule']['aggregation']``)
+AGGREGATION_KINDS = ("sync", "buffered")
+
+#: default staleness mixing coefficient of the buffered-async combine
+DEFAULT_STALENESS = 0.5
+
+#: default Markov on/off chain parameters (P(off->on), P(on->off), trace
+#: length in rounds, trace seed)
+DEFAULT_MARKOV = {"p_on": 0.5, "p_off": 0.2, "length": 64, "seed": 0}
+
+
+def staleness_weight(alpha: float, staleness: int) -> float:
+    """Mixing weight of a buffered update that is ``staleness`` rounds old:
+    ``alpha / sqrt(1 + s)`` -- the standard polynomial staleness discount
+    (FedBuff-style; PAPERS.md 2308.11953 is the convergence frame).  The
+    in-scan buffer holds exactly one round, so the engines evaluate this at
+    ``s = 1``; the formula is THE one definition both engines and the docs
+    share."""
+    return float(alpha) / math.sqrt(1.0 + float(staleness))
+
+
+def markov_trace(num_users: int, length: int, p_on: float, p_off: float,
+                 seed: int) -> np.ndarray:
+    """A replayable ``[length, num_users]`` uint8 availability trace from a
+    seeded two-state Markov chain: each client flips off with ``p_off`` and
+    back on with ``p_on`` per round, initialised at the stationary
+    distribution.  Deterministic in ``seed`` -- re-running (or resuming)
+    regenerates the identical trace, which is what makes Markov scheduling
+    a special case of trace replay."""
+    if num_users < 1 or length < 1:
+        raise ValueError(f"markov trace needs num_users>=1, length>=1 "
+                         f"(got {num_users}, {length})")
+    rng = np.random.default_rng(int(seed))
+    pi_on = p_on / max(p_on + p_off, 1e-12)
+    state = rng.random(num_users) < pi_on
+    rows = np.empty((length, num_users), np.uint8)
+    for t in range(length):
+        rows[t] = state
+        u = rng.random(num_users)
+        state = np.where(state, u >= p_off, u < p_on)
+    return rows
+
+
+class ScheduleSpec:
+    """The resolved scheduler configuration: one immutable object the
+    engines, the driver, staticcheck and bench all consume (built by
+    :func:`resolve_schedule_cfg` -- there is no second parser).
+
+    ``lockstep`` is the contract bit: uniform sampling + no deadline +
+    synchronous aggregation, i.e. every new mechanism off -- the engines
+    must then build byte-identical programs to the pre-scheduler tree."""
+
+    def __init__(self, kind: str = "uniform",
+                 trace: Optional[np.ndarray] = None,
+                 markov: Optional[Dict[str, Any]] = None,
+                 deadline_min_frac: Optional[float] = None,
+                 aggregation: str = "sync",
+                 staleness: float = DEFAULT_STALENESS):
+        self.kind = kind
+        self._trace = trace
+        self.markov = markov
+        self.deadline_min_frac = deadline_min_frac
+        self.aggregation = aggregation
+        self.staleness = staleness
+
+    @property
+    def lockstep(self) -> bool:
+        return (self.kind == "uniform" and self.deadline_min_frac is None
+                and self.aggregation == "sync")
+
+    @property
+    def buffered(self) -> bool:
+        return self.aggregation == "buffered"
+
+    @property
+    def has_deadline(self) -> bool:
+        return self.deadline_min_frac is not None
+
+    @property
+    def trace(self) -> Optional[np.ndarray]:
+        """The ``[T, U]`` uint8 availability matrix (``None`` for uniform).
+        Markov kinds materialise their trace lazily and cache it -- engines
+        that never sample in-jit (host-schedule paths) still share the one
+        replayable matrix through this property."""
+        if self.kind == "uniform":
+            return None
+        if self._trace is None and self.kind == "markov":
+            m = self.markov
+            self._trace = markov_trace(m["num_users"], m["length"],
+                                       m["p_on"], m["p_off"], m["seed"])
+        return self._trace
+
+    def avail_row(self, epoch: int) -> Optional[np.ndarray]:
+        """Round ``epoch``'s availability row (1-based epochs cycle through
+        the trace), or ``None`` for uniform -- the host twin of the in-jit
+        ``trace[(t - 1) % T]`` index, shared so the two streams cannot
+        fork."""
+        t = self.trace
+        if t is None:
+            return None
+        return t[(int(epoch) - 1) % t.shape[0]]
+
+
+def resolve_schedule_cfg(cfg: Dict[str, Any]) -> ScheduleSpec:
+    """Validate ``cfg['schedule']`` and return the :class:`ScheduleSpec`.
+
+    THE one validator (the PR 6/8 convention: unknown keys or malformed
+    values fail loudly at config time, never as a silent lockstep fallback
+    mid-run).  ``None``/absent -> the lockstep spec (zero new behaviour)."""
+    raw = cfg.get("schedule")
+    if raw is None:
+        return ScheduleSpec()
+    if not isinstance(raw, dict):
+        raise ValueError(f"Not valid schedule: {raw!r} (a dict with keys "
+                         f"kind/trace/markov/deadline/aggregation/staleness, "
+                         f"or None for lockstep)")
+    unknown = set(raw) - {"kind", "trace", "markov", "deadline",
+                          "aggregation", "staleness"}
+    if unknown:
+        raise ValueError(f"Not valid schedule keys: {sorted(unknown)}")
+    kind = raw.get("kind", "uniform") or "uniform"
+    if kind not in SCHEDULE_KINDS:
+        raise ValueError(f"Not valid schedule kind: {kind!r} "
+                         f"(one of {SCHEDULE_KINDS})")
+    num_users = cfg.get("num_users")
+    trace = None
+    markov = None
+    if kind == "trace":
+        t = raw.get("trace")
+        if t is None:
+            raise ValueError("schedule kind 'trace' needs a 'trace' entry: "
+                             "a [rounds, num_users] 0/1 availability matrix "
+                             "(nested lists or an array)")
+        trace = np.asarray(t)
+        if trace.ndim != 2 or trace.size == 0:
+            raise ValueError(f"Not valid availability trace shape "
+                             f"{trace.shape}: needs [rounds, num_users] "
+                             f"with both axes non-empty")
+        vals = np.unique(trace)
+        if not np.isin(vals, (0, 1)).all():
+            raise ValueError(f"Not valid availability trace values "
+                             f"{vals.tolist()[:8]}: 0/1 only")
+        if num_users is not None and trace.shape[1] != int(num_users):
+            raise ValueError(
+                f"availability trace covers {trace.shape[1]} users but "
+                f"cfg['num_users']={num_users}: the trace's user axis must "
+                f"match the federation")
+        trace = trace.astype(np.uint8)
+    elif kind == "markov":
+        m = dict(DEFAULT_MARKOV, **(raw.get("markov") or {}))
+        unknown_m = set(m) - {"p_on", "p_off", "length", "seed"}
+        if unknown_m:
+            raise ValueError(f"Not valid schedule markov keys: "
+                            f"{sorted(unknown_m)}")
+        for p in ("p_on", "p_off"):
+            v = m[p]
+            if not isinstance(v, (int, float)) or not 0.0 < float(v) <= 1.0:
+                raise ValueError(f"Not valid markov {p}: {v!r} "
+                                 f"(a probability in (0, 1])")
+        if not isinstance(m["length"], int) or m["length"] < 1:
+            raise ValueError(f"Not valid markov length: {m['length']!r} "
+                             f"(an int >= 1)")
+        if num_users is None:
+            raise ValueError("markov schedule needs cfg['num_users'] "
+                             "(resolve after process_control)")
+        markov = {"p_on": float(m["p_on"]), "p_off": float(m["p_off"]),
+                  "length": int(m["length"]), "seed": int(m.get("seed", 0)),
+                  "num_users": int(num_users)}
+    elif raw.get("trace") is not None or raw.get("markov") is not None:
+        raise ValueError(f"schedule kind {kind!r} takes no trace/markov "
+                         f"entries (set kind='trace'/'markov')")
+    deadline = raw.get("deadline")
+    deadline_min_frac = None
+    if deadline is not None:
+        if not isinstance(deadline, dict) or set(deadline) - {"min_frac"}:
+            raise ValueError(f"Not valid schedule deadline: {deadline!r} "
+                             f"(a dict {{'min_frac': f}} with f in (0, 1), "
+                             f"or None)")
+        f = deadline.get("min_frac")
+        if not isinstance(f, (int, float)) or not 0.0 < float(f) < 1.0:
+            raise ValueError(f"Not valid deadline min_frac: {f!r} (the "
+                             f"slowest client's fraction of the full local "
+                             f"step budget, in (0, 1); 1.0 would be "
+                             f"lockstep -- drop the deadline instead)")
+        deadline_min_frac = float(f)
+    agg = raw.get("aggregation", "sync") or "sync"
+    if agg not in AGGREGATION_KINDS:
+        raise ValueError(f"Not valid schedule aggregation: {agg!r} "
+                         f"(one of {AGGREGATION_KINDS})")
+    staleness = raw.get("staleness", DEFAULT_STALENESS)
+    if not isinstance(staleness, (int, float)) \
+            or not 0.0 < float(staleness) <= 1.0:
+        raise ValueError(f"Not valid schedule staleness: {staleness!r} "
+                         f"(the buffered combine's mixing coefficient, in "
+                         f"(0, 1])")
+    return ScheduleSpec(kind=kind, trace=trace, markov=markov,
+                        deadline_min_frac=deadline_min_frac,
+                        aggregation=agg, staleness=float(staleness))

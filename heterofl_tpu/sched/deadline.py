@@ -1,0 +1,49 @@
+"""Deadline stragglers (ISSUE 9): per-client local-step budgets, in-jit.
+
+Real deployments impose a wall-clock deadline per round; slow clients
+either drop (the reference's implicit behaviour, generalised by
+``client_failure_rate``) or upload whatever they finished.  This module
+implements the second, better-behaved semantics: each active client draws
+a per-round step budget from a seeded ``(round key, user id)`` stream and
+its local-step scan masks out every step past the budget -- the optimizer
+update AND the metric contributions gate off together, so a truncated
+client contributes exactly its completed steps' training and nothing else.
+
+The draw is pure in-scan arithmetic and engine-invariant: both engines
+fold the SAME round key and global user id, and the step budget scales the
+SAME static ``E x S`` total, so the masked and grouped engines truncate
+identically (the cross-engine equivalence contract survives at its usual
+association tolerance).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: PRNG salt of the deadline stream -- disjoint from the engines'
+#: per-client (13) and failure (98) salts, fed.core's rate/user salts and
+#: the codec salts (compress.codecs)
+DEADLINE_SALT = 131
+
+
+def deadline_steps(key: jax.Array, uids: jnp.ndarray, total_steps: int,
+                   min_frac: float) -> jnp.ndarray:
+    """Per-client local-step budgets for one round: ``[slots] int32`` in
+    ``[ceil(min_frac * total), total]``.
+
+    Each client's speed is an i.i.d. uniform draw from
+    ``fold_in(fold_in(round_key, DEADLINE_SALT), uid)`` -- deterministic,
+    replayable, identical across engines/placements (global uid keyed, like
+    every per-client stream).  ``min_frac`` is the slowest client's
+    fraction of the full budget; the ``ceil`` keeps every participant at
+    >= 1 completed step, so a deadline round never degenerates to a pure
+    dropout round (use ``client_failure_rate`` for crashes)."""
+    dkey = jax.random.fold_in(key, DEADLINE_SALT)
+
+    def one(u):
+        speed = jax.random.uniform(jax.random.fold_in(dkey, u))
+        frac = min_frac + (1.0 - min_frac) * speed
+        return jnp.ceil(frac * total_steps).astype(jnp.int32)
+
+    return jax.vmap(one)(jnp.maximum(uids, 0))

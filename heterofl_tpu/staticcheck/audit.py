@@ -605,6 +605,131 @@ def _codec_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
     return targets
 
 
+def _sched_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
+    """Scheduler variants (ISSUE 9): the program matrix grows the scenario
+    mechanisms so the standing gates cover them --
+
+    * ``-trace``: the masked in-jit sampler with an availability trace
+      riding as a replicated program argument (the selection arithmetic
+      adds NO collective: one global psum, dense wire budget, full params
+      donation, all unchanged);
+    * ``-deadline``: per-client step truncation (pure in-scan arithmetic:
+      same budgets as lockstep) for both engines;
+    * ``-buffered``: the buffered-async staleness carry -- donation pins to
+      the buffer ONLY (the codec programs' XLA:CPU serialization-bug
+      policy), the wire budget stays the one dense reduction (buffering is
+      post-psum), and the carry's bytes land in the donation-savings
+      accounting;
+    * ``-perlevel``: the grouped per-level codec map (level-a int8, rest
+      dense): ONE psum bind whose payload is budgeted BY EQUALITY against
+      :func:`~..fed.core.level_codec_map_byte_table`'s per-level sum.
+    """
+    import jax
+
+    from ..fed.core import level_codec_map_byte_table
+    from ..ops.fused_update import FlatSpec
+    from ..parallel import GroupedRoundEngine, RoundEngine
+    from ..parallel.grouped import _bucket_pow2
+    from ..sched import markov_trace
+    from ..utils.optim import make_traced_lr_fn
+
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    params, key = setup["params"], setup["key"]
+    users = setup["users"]
+    n_dev = mesh.shape["clients"]
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    total = FlatSpec.of(params).total
+    bt = setup["byte_table"]
+    top = max(bt)
+    wire = bt[top]["wire_bytes"]
+    k = 8
+    a = int(math.ceil(cfg["frac"] * users))
+    per_dev = _ceil_div(a, n_dev)
+    per_level = 2
+    per_dev_g = _bucket_pow2(_ceil_div(per_level, n_dev))
+    data = tuple(setup["data"])
+    targets = []
+
+    def mem(cpd: int) -> Dict[str, int]:
+        return _mem_expect(bt, top, cpd)
+
+    # availability trace, in-jit sampling (masked replicated)
+    trace = markov_trace(users, k, 0.6, 0.4, seed=0)
+    tcfg = dict(cfg, schedule={"kind": "trace", "trace": trace.tolist()})
+    eng_tr = RoundEngine(model, tcfg, mesh)
+    eng_tr._lr_fn = make_traced_lr_fn(cfg)
+    fix = (eng_tr.fix_rates,) if eng_tr.fix_rates is not None else ()
+    targets.append((
+        "masked/replicated/k8-trace",
+        eng_tr._build_superstep(k, per_dev, True, num_active=a),
+        (params, key, np.int32(1), eng_tr._sched_spec.trace) + data + fix,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev)}))
+
+    # deadline stragglers: both engines
+    dcfg = dict(cfg, schedule={"deadline": {"min_frac": 0.5}})
+    eng_dl = RoundEngine(model, dcfg, mesh)
+    eng_dl._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "masked/replicated/k8-deadline",
+        eng_dl._build_superstep(k, per_dev, True, num_active=a),
+        (params, key, np.int32(1)) + data + fix,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev)}))
+    grp_dl = GroupedRoundEngine(dcfg, mesh)
+    grp_dl._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "grouped/span/k8-fused-deadline",
+        grp_dl._superstep_prog(k, per_dev_g, "span"),
+        (params, key, np.int32(1),
+         _sds((k, len(grp_dl.levels), per_dev_g * n_dev))) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev_g)}))
+
+    # buffered-async aggregation: both engines, buf-only donation
+    bcfg = dict(cfg, schedule={"aggregation": "buffered"})
+    buf_sds = _sds((2, total), np.float32)
+    buf_bytes = 2 * total * 4
+    eng_bf = RoundEngine(model, bcfg, mesh)
+    eng_bf._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "masked/replicated/k8-buffered",
+        eng_bf._build_superstep(k, per_dev, True, num_active=a),
+        (params, buf_sds, key, np.int32(1)) + data + fix,
+        {"donated": 1, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "donated_bytes": buf_bytes, "mem": mem(per_dev)}))
+    grp_bf = GroupedRoundEngine(bcfg, mesh)
+    grp_bf._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "grouped/span/k8-fused-buffered",
+        grp_bf._superstep_prog(k, per_dev_g, "span"),
+        (params, buf_sds, key, np.int32(1),
+         _sds((k, len(grp_bf.levels), per_dev_g * n_dev))) + data,
+        {"donated": 1, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "donated_bytes": buf_bytes, "mem": mem(per_dev_g)}))
+
+    # per-level codec map (ISSUE 9 satellite): level-a int8, rest dense --
+    # the single bind's payload equals the per-level byte-table sum
+    level_rates = sorted(bt, reverse=True)
+    codec_map = {r: ("int8" if r == top else "dense") for r in level_rates}
+    mcfg = dict(cfg, wire_codec={f"{r:g}": c for r, c in codec_map.items()})
+    grp_pl = GroupedRoundEngine(mcfg, mesh)
+    grp_pl._lr_fn = make_traced_lr_fn(cfg)
+    lay = grp_pl._map_layout(params)
+    wire_map = sum(level_codec_map_byte_table(
+        cfg, codec_map, n_leaves=n_leaves).values())
+    resid_bytes = n_dev * 2 * lay["total_lossy"] * 4
+    targets.append((
+        "grouped/span/k8-fused-perlevel",
+        grp_pl._superstep_prog(k, per_dev_g, "span"),
+        (params, _sds((n_dev, 2, lay["total_lossy"]), np.float32), key,
+         np.int32(1), _sds((k, len(grp_pl.levels), per_dev_g * n_dev)))
+        + data,
+        {"donated": 1, "psum": PSUM_BUDGET, "wire_bytes": wire_map,
+         "donated_bytes": resid_bytes, "mem": mem(per_dev_g)}))
+    return targets
+
+
 def codec_frontier_check(report: "AuditReport") -> Dict[str, Any]:
     """The analytic flagship compression frontier (ISSUE 8 acceptance): each
     codec's per-round payload at full CIFAR-10 ResNet-18 widths vs the
@@ -998,6 +1123,7 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
     grouped, level_prog_names, _ = _grouped_targets(setup)
     targets.extend(grouped)
     targets.extend(_codec_targets(setup))
+    targets.extend(_sched_targets(setup))
     for name, prog, args, expect in targets:
         report.add_program(audit_program(name, prog, args, expect, mesh))
 

@@ -67,8 +67,14 @@ _PARALLEL = ("heterofl_tpu/parallel/",)
 #: constant coercions carry `allow` pragmas with their reasons.  The wire
 #: codecs (ISSUE 8, compress/) encode/decode inside the scanned superstep,
 #: so they are hot-path code under the same rules.
+#: the scheduler's jax halves (ISSUE 9): deadline draws and the staleness
+#: buffer run inside the scanned superstep -- hot-path code under the same
+#: rules.  sched/__init__ is the import-light config/validation half (like
+#: config.py) and stays out of scope: its float()/rng calls parse host
+#: config, never device values.
+_SCHED = ("heterofl_tpu/sched/deadline", "heterofl_tpu/sched/buffer")
 _KERNEL = ("heterofl_tpu/ops/", "heterofl_tpu/models/",
-           "heterofl_tpu/compress/")
+           "heterofl_tpu/compress/") + _SCHED
 _TRACED = ("heterofl_tpu/parallel/", "heterofl_tpu/fed/") + _KERNEL
 _DRIVER = ("heterofl_tpu/entry/",)
 
@@ -113,7 +119,7 @@ DEFAULT_RULES: Tuple[Rule, ...] = (
          "every jax.jit in the round path must take an explicit donation "
          "stance (donate_argnums/donate_argnames), or carry an allow pragma "
          "saying why buffers must survive",
-         _PARALLEL,
+         _PARALLEL + ("heterofl_tpu/sched/buffer",),
          calls=("jax.jit",),
          require_kwargs=("donate_argnums", "donate_argnames")),
     Rule("no-shadowed-inline-import",

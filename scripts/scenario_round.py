@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Scenario accuracy round (ISSUE 9 / MEASUREMENTS.md Round 13): lockstep
+vs availability / straggler / buffered-async regimes, end-to-end through
+the fed driver on the synthetic MNIST pair.
+
+Runs one FedExperiment per scenario (same seed, same data split, same
+100-round horizon at superstep_rounds=10, eval every 10) and reports the
+Global-Accuracy trajectory facts the scenario comparison needs: final/best
+accuracy, rounds-to-target (first eval reaching the target accuracy), and
+the realised participation statistics of the schedule.
+
+    JAX_PLATFORMS=cpu python scripts/scenario_round.py [--fast] [--out f]
+
+``--fast`` shrinks the horizon for smoke runs.  Writes one JSON object to
+stdout (and ``--out`` if given).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCENARIOS = {
+    "lockstep": None,
+    "markov": {"kind": "markov",
+               "markov": {"p_on": 0.5, "p_off": 0.25, "length": 32,
+                          "seed": 0}},
+    "deadline": {"deadline": {"min_frac": 0.25}},
+    "buffered": {"aggregation": "buffered", "staleness": 0.5},
+    "markov+deadline+buffered": {
+        "kind": "markov",
+        "markov": {"p_on": 0.5, "p_off": 0.25, "length": 32, "seed": 0},
+        "deadline": {"min_frac": 0.25},
+        "aggregation": "buffered", "staleness": 0.5},
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="20-round smoke instead of the 100-round round")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--target", type=float, default=60.0,
+                    help="rounds-to-target accuracy threshold (Global-Acc)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from heterofl_tpu import config as C
+    from heterofl_tpu.entry.common import FedExperiment
+    from heterofl_tpu.fed.core import superstep_user_schedule
+    from heterofl_tpu.sched import resolve_schedule_cfg
+
+    rounds = 20 if args.fast else 100
+    k = 10
+    results = {}
+    for name, sched in SCENARIOS.items():
+        cfg = C.default_cfg()
+        cfg["control"] = C.parse_control_name(
+            "1_10_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+        cfg["data_name"] = "MNIST"
+        cfg["model_name"] = "conv"
+        cfg["synthetic"] = True
+        cfg["synthetic_sizes"] = {"train": 2000, "test": 500}
+        cfg["output_dir"] = f"/tmp/scenario_round/{name.replace('+', '_')}"
+        cfg["schedule"] = sched
+        cfg["override"] = {"num_epochs": {"global": rounds, "local": 5},
+                           "conv": {"hidden_size": [8, 16]},
+                           "superstep_rounds": k, "eval_interval": k}
+        cfg = C.process_control(cfg)
+        exp = FedExperiment(cfg, 0)
+        out = exp.run("Global-Accuracy")
+        hist = out["logger"].history
+        accs = [float(a) for a in hist.get("test/Global-Accuracy", [])]
+        eval_epochs = list(range(k, rounds + 1, k))
+        to_target = next((e for e, a in zip(eval_epochs, accs)
+                          if a >= args.target), None)
+        spec = resolve_schedule_cfg(cfg)
+        us = superstep_user_schedule(exp.host_key, 1, rounds,
+                                     cfg["num_users"], exp.num_active,
+                                     schedule=spec)
+        filled = (us >= 0).sum(axis=1)
+        results[name] = {
+            "final_acc": round(accs[-1], 2) if accs else None,
+            "best_acc": round(max(accs), 2) if accs else None,
+            "rounds_to_target": to_target,
+            "target": args.target,
+            "eval_accs": [round(a, 2) for a in accs],
+            "participation": {
+                "slots_per_round": int(exp.num_active),
+                "mean_active": round(float(np.mean(filled)), 2),
+                "min_active": int(filled.min()),
+                "max_active": int(filled.max()),
+            },
+        }
+        print(f"# {name}: final {results[name]['final_acc']} best "
+              f"{results[name]['best_acc']} to-target "
+              f"{results[name]['rounds_to_target']} mean-active "
+              f"{results[name]['participation']['mean_active']}",
+              file=sys.stderr, flush=True)
+    rec = {"rounds": rounds, "superstep_rounds": k, "seed": 0,
+           "pair": "synthetic MNIST conv[8,16] 1_10_0.5 a1-e1 fix",
+           "scenarios": results}
+    text = json.dumps(rec, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,646 @@
+"""Scheduler subsystem (ISSUE 9): availability traces, deadline stragglers,
+buffered-async aggregation, per-level codec maps, rolling eval cohorts.
+
+The contracts under test:
+
+* **lockstep untouched** -- ``schedule=None`` and ``{"kind": "uniform"}``
+  build the same programs and the same trajectories (zero new carry args);
+* **replayable sampling** -- trace/markov schedules reproduce identical
+  cohorts across runs and across a resume-style re-draw, the in-jit trace
+  path is bit-identical to the host-schedule path, and all-ones
+  availability IS the uniform stream;
+* **deadline + buffered** -- superstep == sequential bit for bit (the
+  staleness buffer carried across dispatches via its checkpoint pair),
+  both engines;
+* **per-level codec map** -- the grouped fused superstep compresses each
+  level under its own codec in one psum bind, with the concatenated EF
+  residual round-tripping through save/restore;
+* **rolling eval cohort** -- O(cohort) Local eval on the streaming store
+  with loud validation and the O(U) warning retired.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heterofl_tpu import config as C
+from heterofl_tpu.fed.core import (round_rates, round_users,
+                                   superstep_rate_schedule,
+                                   superstep_user_schedule)
+from heterofl_tpu.models import make_model
+from heterofl_tpu.parallel import GroupedRoundEngine, RoundEngine, make_mesh
+from heterofl_tpu.sched import (ScheduleSpec, markov_trace,
+                                resolve_schedule_cfg, staleness_weight)
+
+from test_round import _vision_setup
+
+HOST_KEY = jax.random.key(0)
+
+
+def _lr_host(cfg, epoch):
+    """Sequential baselines consume the traced LR schedule host-evaluated
+    (f32) -- exactly what the superstep computes in-jit (test_superstep's
+    convention)."""
+    from heterofl_tpu.utils.optim import make_traced_lr_fn
+
+    return float(np.asarray(make_traced_lr_fn(cfg)(jnp.int32(epoch))))
+
+
+def _params_equal(a, b):
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+
+
+def _trace_cfg(cfg, trace, **extra):
+    return dict(cfg, schedule={"kind": "trace", "trace": trace.tolist(),
+                               **extra})
+
+
+# ---------------------------------------------------------------------------
+# the sampling stream
+# ---------------------------------------------------------------------------
+
+def test_round_users_all_ones_availability_is_uniform():
+    """An all-ones availability row must select exactly the uniform cohort
+    (the stable sort preserves permutation order) -- trace replay is a
+    strict generalisation of the uniform stream."""
+    key = jax.random.key(3)
+    base = np.asarray(round_users(key, 16, 6))
+    avail = np.asarray(round_users(key, 16, 6, avail=np.ones(16, np.uint8)))
+    np.testing.assert_array_equal(base, avail)
+
+
+def test_round_users_partial_availability_pads_with_minus_one():
+    key = jax.random.key(4)
+    avail = np.zeros(16, np.uint8)
+    avail[[2, 5]] = 1
+    got = np.asarray(round_users(key, 16, 6, avail=avail))
+    assert got.shape == (6,)
+    assert set(got[got >= 0].tolist()) == {2, 5}
+    assert (got[2:] == -1).all()  # available users drawn first, then padding
+    # deterministic: the same key + row reproduces the draw
+    np.testing.assert_array_equal(
+        got, np.asarray(round_users(jax.random.key(4), 16, 6, avail=avail)))
+
+
+def test_markov_trace_replayable_and_binary():
+    t1 = markov_trace(12, 9, 0.5, 0.3, seed=7)
+    t2 = markov_trace(12, 9, 0.5, 0.3, seed=7)
+    np.testing.assert_array_equal(t1, t2)
+    assert t1.shape == (9, 12) and set(np.unique(t1)) <= {0, 1}
+    assert markov_trace(12, 9, 0.5, 0.3, seed=8).tolist() != t1.tolist()
+
+
+def test_schedule_replay_across_runs_and_resume():
+    """Trace-driven cohorts reproduce across independent draws AND across a
+    checkpoint-resume-style re-draw from a later epoch: the [k, A] schedule
+    is a pure function of (host key, epochs, spec)."""
+    spec = resolve_schedule_cfg({
+        "num_users": 10,
+        "schedule": {"kind": "markov",
+                     "markov": {"p_on": 0.6, "p_off": 0.4, "length": 6,
+                                "seed": 3}}})
+    full = superstep_user_schedule(HOST_KEY, 1, 8, 10, 4, schedule=spec)
+    again = superstep_user_schedule(HOST_KEY, 1, 8, 10, 4, schedule=spec)
+    np.testing.assert_array_equal(full, again)
+    resumed = superstep_user_schedule(HOST_KEY, 5, 4, 10, 4, schedule=spec)
+    np.testing.assert_array_equal(full[4:], resumed)
+    # the trace cycles past its length (epoch 7 reuses row (7-1) % 6)
+    assert spec.avail_row(7).tolist() == spec.avail_row(1).tolist()
+
+
+def test_resolve_schedule_cfg_validation():
+    ok = resolve_schedule_cfg({"schedule": None})
+    assert ok.lockstep and ok.trace is None
+    assert resolve_schedule_cfg({"schedule": {"kind": "uniform"}}).lockstep
+    with pytest.raises(ValueError, match="schedule kind"):
+        resolve_schedule_cfg({"schedule": {"kind": "round-robin"}})
+    with pytest.raises(ValueError, match="schedule keys"):
+        resolve_schedule_cfg({"schedule": {"knd": "uniform"}})
+    with pytest.raises(ValueError, match="needs a 'trace'"):
+        resolve_schedule_cfg({"schedule": {"kind": "trace"}})
+    with pytest.raises(ValueError, match="0/1 only"):
+        resolve_schedule_cfg({"schedule": {"kind": "trace",
+                                           "trace": [[2, 0], [1, 1]]}})
+    with pytest.raises(ValueError, match="num_users"):
+        resolve_schedule_cfg({"num_users": 3,
+                              "schedule": {"kind": "trace",
+                                           "trace": [[1, 0], [1, 1]]}})
+    with pytest.raises(ValueError, match="min_frac"):
+        resolve_schedule_cfg({"schedule": {"deadline": {"min_frac": 1.5}}})
+    with pytest.raises(ValueError, match="aggregation"):
+        resolve_schedule_cfg({"schedule": {"aggregation": "async"}})
+    with pytest.raises(ValueError, match="staleness"):
+        resolve_schedule_cfg({"schedule": {"staleness": 0.0}})
+    with pytest.raises(ValueError, match="markov"):
+        resolve_schedule_cfg({"num_users": 4,
+                              "schedule": {"kind": "markov",
+                                           "markov": {"p_on": 2.0}}})
+    assert staleness_weight(0.5, 1) == pytest.approx(0.5 / np.sqrt(2.0))
+
+
+# ---------------------------------------------------------------------------
+# lockstep bit-identity (the zero-new-args contract)
+# ---------------------------------------------------------------------------
+
+def test_uniform_schedule_is_bit_identical_to_no_schedule():
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    k, A = 2, 4
+
+    def run(c):
+        eng = RoundEngine(model, c, mesh)
+        p = model.init(jax.random.key(0))
+        p, pending = eng.train_superstep(p, HOST_KEY, 1, k, data, num_active=A)
+        return p, pending.fetch()
+
+    p0, ms0 = run(cfg)
+    p1, ms1 = run(dict(cfg, schedule={"kind": "uniform",
+                                      "aggregation": "sync"}))
+    _params_equal(p0, p1)
+    for r in range(k):
+        np.testing.assert_array_equal(np.asarray(ms0[r]["n"]),
+                                      np.asarray(ms1[r]["n"]))
+
+
+# ---------------------------------------------------------------------------
+# availability traces inside the engines
+# ---------------------------------------------------------------------------
+
+def test_trace_superstep_in_jit_matches_host_schedule_bitwise():
+    """The masked engine's in-jit trace sampling (the trace rides as a
+    program argument) is bit-identical to dispatching the SAME engine with
+    the host-drawn schedule -- the two halves of the one stream."""
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    k, A = 2, 4
+    trace = markov_trace(cfg["num_users"], 5, 0.6, 0.5, seed=2)
+    assert trace.sum() not in (0, trace.size)  # a real mix of on/off
+    scfg = _trace_cfg(cfg, trace)
+    spec = resolve_schedule_cfg(scfg)
+
+    eng = RoundEngine(model, scfg, mesh)
+    p_jit = model.init(jax.random.key(0))
+    p_jit, pend = eng.train_superstep(p_jit, HOST_KEY, 1, k, data,
+                                      num_active=A)
+    ms_jit = pend.fetch()
+
+    sched = superstep_user_schedule(HOST_KEY, 1, k, cfg["num_users"], A,
+                                    schedule=spec)
+    eng2 = RoundEngine(model, scfg, mesh)
+    p_host = model.init(jax.random.key(0))
+    p_host, pend = eng2.train_superstep(p_host, HOST_KEY, 1, k, data,
+                                        user_schedule=sched)
+    ms_host = pend.fetch()
+    _params_equal(p_jit, p_host)
+    for r in range(k):
+        np.testing.assert_array_equal(np.asarray(ms_jit[r]["n"]),
+                                      np.asarray(ms_host[r]["n"]))
+    # unavailable slots really sat out: round r's participants are capped
+    # by the trace row's availability
+    for r in range(k):
+        avail = int(spec.avail_row(1 + r).sum())
+        active = int((np.asarray(ms_jit[r]["n"]) > 0).sum())
+        assert active <= min(A, avail)
+
+
+def test_trace_schedule_grouped_handles_unfilled_slots():
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(4, 1)
+    k, A = 2, 4
+    trace = np.zeros((3, cfg["num_users"]), np.uint8)
+    trace[:, :2] = 1  # only users 0/1 ever available -> 2 of 4 slots fill
+    scfg = _trace_cfg(cfg, trace)
+    spec = resolve_schedule_cfg(scfg)
+    sched = superstep_user_schedule(HOST_KEY, 1, k, cfg["num_users"], A,
+                                    schedule=spec)
+    assert (sched == -1).any()
+    rates = superstep_rate_schedule(HOST_KEY, 1, k, scfg, sched)
+    grp = GroupedRoundEngine(scfg, mesh)
+    model = make_model(cfg)
+    p = model.init(jax.random.key(0))
+    p, pending = grp.train_superstep(p, HOST_KEY, 1, k, sched, rates, data)
+    ms = pending.fetch()
+    for r in range(k):
+        n = np.asarray(ms[r]["n"])
+        assert (n[sched[r] == -1] == 0).all()
+        assert (n[sched[r] >= 0] > 0).all()
+        assert np.isfinite(np.asarray(ms[r]["loss_sum"])).all()
+    assert all(np.isfinite(np.asarray(v)).all() for v in p.values())
+
+
+# ---------------------------------------------------------------------------
+# deadline stragglers
+# ---------------------------------------------------------------------------
+
+def test_deadline_superstep_masked_bit_identical_to_sequential():
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    k, A = 3, 4
+    dcfg = dict(cfg, schedule={"deadline": {"min_frac": 0.3}})
+
+    eng_seq = RoundEngine(model, dcfg, mesh)
+    p_seq = model.init(jax.random.key(0))
+    seq_ms = []
+    for r in range(k):
+        e = 1 + r
+        key = jax.random.fold_in(HOST_KEY, e)
+        uidx = np.asarray(round_users(key, cfg["num_users"], A))
+        p_seq, ms = eng_seq.train_round(p_seq, key, _lr_host(dcfg, e), uidx,
+                                        data)
+        seq_ms.append({n: np.asarray(v) for n, v in ms.items()})
+
+    eng = RoundEngine(model, dcfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, pending = eng.train_superstep(p, HOST_KEY, 1, k, data, num_active=A)
+    ss_ms = pending.fetch()
+    _params_equal(p_seq, p)
+    for r in range(k):
+        for name in ("loss_sum", "score_sum", "n", "rate"):
+            np.testing.assert_array_equal(seq_ms[r][name],
+                                          np.asarray(ss_ms[r][name]),
+                                          err_msg=f"round {r} {name}")
+
+
+def test_deadline_truncates_training_and_metrics():
+    """A tight deadline must actually shrink the per-client processed
+    sample counts vs lockstep, and produce different params (the step
+    truncation is real, not a no-op)."""
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    uidx = np.array([0, 1, 2, 3])
+    key = jax.random.key(11)
+
+    eng0 = RoundEngine(model, cfg, mesh)
+    p0, ms0 = eng0.train_round(model.init(jax.random.key(0)), key, 0.05,
+                               uidx, data)
+    engd = RoundEngine(model, dict(cfg, schedule={"deadline":
+                                                  {"min_frac": 0.2}}), mesh)
+    pd, msd = engd.train_round(model.init(jax.random.key(0)), key, 0.05,
+                               uidx, data)
+    n0 = float(np.asarray(ms0["n"]).sum())
+    nd = float(np.asarray(msd["n"]).sum())
+    assert 0 < nd < n0
+    assert any(not np.array_equal(np.asarray(p0[k]), np.asarray(pd[k]))
+               for k in p0)
+    assert all(np.isfinite(np.asarray(v)).all() for v in pd.values())
+
+
+def test_deadline_grouped_superstep_bit_identical_to_k1_sequence():
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(4, 1)
+    model = make_model(cfg)
+    k, A = 2, 4
+    dcfg = dict(cfg, schedule={"deadline": {"min_frac": 0.3}})
+    sched = superstep_user_schedule(HOST_KEY, 1, k, cfg["num_users"], A)
+    rates = superstep_rate_schedule(HOST_KEY, 1, k, dcfg, sched)
+
+    grp_seq = GroupedRoundEngine(dcfg, mesh)
+    p_seq = model.init(jax.random.key(0))
+    for r in range(k):
+        p_seq, pend = grp_seq.train_superstep(
+            p_seq, HOST_KEY, 1 + r, 1, sched[r:r + 1], rates[r:r + 1], data)
+        pend.fetch()
+
+    grp = GroupedRoundEngine(dcfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, pend = grp.train_superstep(p, HOST_KEY, 1, k, sched, rates, data)
+    pend.fetch()
+    _params_equal(p_seq, p)
+
+
+# ---------------------------------------------------------------------------
+# buffered asynchronous aggregation
+# ---------------------------------------------------------------------------
+
+BUF_SCHED = {"aggregation": "buffered", "staleness": 0.5}
+
+
+def test_buffered_masked_superstep_matches_sequential_with_carried_buffer():
+    """superstep == sequential with the staleness buffer carried bit for
+    bit: K=1 rounds on one engine (the buffer rides the engine state)
+    reproduce one K-round superstep on a fresh engine exactly."""
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    k, A = 3, 4
+    bcfg = dict(cfg, schedule=dict(BUF_SCHED))
+
+    eng_seq = RoundEngine(model, bcfg, mesh)
+    p_seq = model.init(jax.random.key(0))
+    for r in range(k):
+        e = 1 + r
+        key = jax.random.fold_in(HOST_KEY, e)
+        uidx = np.asarray(round_users(key, cfg["num_users"], A))
+        p_seq, _ = eng_seq.train_round(p_seq, key, _lr_host(bcfg, e), uidx,
+                                       data)
+
+    eng = RoundEngine(model, bcfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, pending = eng.train_superstep(p, HOST_KEY, 1, k, data, num_active=A)
+    pending.fetch()
+    _params_equal(p_seq, p)
+    # the carries agree too (the buffer holds round k's pending update)
+    np.testing.assert_array_equal(eng_seq.sched_buf_host(),
+                                  eng.sched_buf_host())
+    # and buffering genuinely changes the trajectory vs sync lockstep
+    eng0 = RoundEngine(model, cfg, mesh)
+    p0 = model.init(jax.random.key(0))
+    p0, pend0 = eng0.train_superstep(p0, HOST_KEY, 1, k, data, num_active=A)
+    pend0.fetch()
+    assert any(not np.array_equal(np.asarray(p0[k_]), np.asarray(p[k_]))
+               for k_ in p0)
+
+
+def test_buffered_carry_checkpoint_roundtrip_masked():
+    """Save/restore the staleness buffer mid-run: the resumed trajectory is
+    bit-identical to the uninterrupted one (the ISSUE 9 checkpoint
+    contract, engine level -- what the driver's blob round-trips)."""
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    A = 4
+    bcfg = dict(cfg, schedule=dict(BUF_SCHED))
+
+    eng = RoundEngine(model, bcfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, pend = eng.train_superstep(p, HOST_KEY, 1, 2, data, num_active=A)
+    pend.fetch()
+    p, pend = eng.train_superstep(p, HOST_KEY, 3, 2, data, num_active=A)
+    pend.fetch()
+    full_buf = eng.sched_buf_host()
+
+    eng_a = RoundEngine(model, bcfg, mesh)
+    p_a = model.init(jax.random.key(0))
+    p_a, pend = eng_a.train_superstep(p_a, HOST_KEY, 1, 2, data, num_active=A)
+    pend.fetch()
+    saved_p = {k_: np.asarray(v) for k_, v in p_a.items()}
+    saved_buf = np.array(eng_a.sched_buf_host())  # the checkpoint blob
+    assert saved_buf.ndim == 2 and saved_buf.shape[0] == 2
+
+    eng_b = RoundEngine(model, bcfg, mesh)  # a fresh process, post-resume
+    eng_b.set_sched_buf(saved_buf)
+    p_b = {k_: jnp.asarray(v) for k_, v in saved_p.items()}
+    p_b, pend = eng_b.train_superstep(p_b, HOST_KEY, 3, 2, data, num_active=A)
+    pend.fetch()
+    _params_equal(p, p_b)
+    np.testing.assert_array_equal(full_buf, eng_b.sched_buf_host())
+
+
+def test_buffered_grouped_superstep_and_roundtrip():
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(4, 1)
+    model = make_model(cfg)
+    k, A = 2, 4
+    bcfg = dict(cfg, schedule=dict(BUF_SCHED))
+    sched = superstep_user_schedule(HOST_KEY, 1, 2 * k, cfg["num_users"], A)
+    rates = superstep_rate_schedule(HOST_KEY, 1, 2 * k, bcfg, sched)
+
+    grp = GroupedRoundEngine(bcfg, mesh)
+    p = model.init(jax.random.key(0))
+    p, pend = grp.train_superstep(p, HOST_KEY, 1, 2 * k, sched, rates, data)
+    pend.fetch()
+
+    grp_a = GroupedRoundEngine(bcfg, mesh)
+    p_a = model.init(jax.random.key(0))
+    p_a, pend = grp_a.train_superstep(p_a, HOST_KEY, 1, k, sched[:k],
+                                      rates[:k], data)
+    pend.fetch()
+    buf = np.array(grp_a.sched_buf_host())
+    grp_b = GroupedRoundEngine(bcfg, mesh)
+    grp_b.set_sched_buf(buf)
+    p_b = {k_: jnp.asarray(np.asarray(v)) for k_, v in p_a.items()}
+    p_b, pend = grp_b.train_superstep(p_b, HOST_KEY, 1 + k, k, sched[k:],
+                                      rates[k:], data)
+    pend.fetch()
+    _params_equal(p, p_b)
+    np.testing.assert_array_equal(grp.sched_buf_host(),
+                                  grp_b.sched_buf_host())
+
+
+def test_buffered_grouped_k1_train_round_refused():
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(4, 1)
+    grp = GroupedRoundEngine(dict(cfg, schedule=dict(BUF_SCHED)), mesh)
+    with pytest.raises(ValueError, match="buffered"):
+        grp.train_round(make_model(cfg).init(jax.random.key(0)),
+                        np.array([0, 1]), np.array([1.0, 1.0]), data, 0.05,
+                        jax.random.key(1))
+
+
+def test_buffered_plus_lossy_codec_refused():
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(4, 1)
+    bad = dict(cfg, schedule=dict(BUF_SCHED), wire_codec="int8")
+    with pytest.raises(ValueError, match="buffered"):
+        RoundEngine(make_model(cfg), bad, mesh)
+    with pytest.raises(ValueError, match="buffered"):
+        GroupedRoundEngine(bad, mesh)
+
+
+# ---------------------------------------------------------------------------
+# per-level codec map (satellite)
+# ---------------------------------------------------------------------------
+
+def _level_map(cfg, lossy="int8"):
+    rates = sorted({float(r) for r in cfg["model_rate"]}, reverse=True)
+    return {f"{r:g}": (lossy if i == 0 else "dense")
+            for i, r in enumerate(rates)}
+
+
+def test_per_level_codec_map_close_to_dense_and_roundtrips():
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(4, 1)
+    model = make_model(cfg)
+    k, A = 2, 8  # every user active so all levels populate
+    sched = superstep_user_schedule(HOST_KEY, 1, k, cfg["num_users"], A)
+    rates = superstep_rate_schedule(HOST_KEY, 1, k, cfg, sched)
+
+    mcfg = dict(cfg, wire_codec=_level_map(cfg))
+    grp = GroupedRoundEngine(mcfg, mesh)
+    assert grp._codec_map is not None
+    p = model.init(jax.random.key(0))
+    p, pend = grp.train_superstep(p, HOST_KEY, 1, k, sched, rates, data)
+    pend.fetch()
+
+    grp_d = GroupedRoundEngine(cfg, mesh)
+    p_d = model.init(jax.random.key(0))
+    p_d, pend = grp_d.train_superstep(p_d, HOST_KEY, 1, k, sched, rates, data)
+    pend.fetch()
+    # level-a int8 / rest dense: a lossy but small perturbation vs dense
+    num = den = 0.0
+    for k_ in p:
+        d = np.asarray(p[k_], np.float64) - np.asarray(p_d[k_], np.float64)
+        num += float((d ** 2).sum())
+        den += float((np.asarray(p_d[k_], np.float64) ** 2).sum())
+    rel = np.sqrt(num / max(den, 1e-12))
+    assert rel < 0.3, rel
+    assert all(np.isfinite(np.asarray(v)).all() for v in p.values())
+
+    # concatenated EF residual: [n_dev, 2, total_lossy], checkpoint
+    # round-trip bit-identical (the _WireCodecCarry pair, map layout)
+    resid = grp.wire_resid_host()
+    assert resid is not None and resid.ndim == 3 and resid.shape[1] == 2
+    assert resid.shape[2] == grp._map_layout(p)["total_lossy"]
+
+    grp_a = GroupedRoundEngine(mcfg, mesh)
+    p_a = model.init(jax.random.key(0))
+    p_a, pend = grp_a.train_superstep(p_a, HOST_KEY, 1, 1, sched[:1],
+                                      rates[:1], data)
+    pend.fetch()
+    saved = np.array(grp_a.wire_resid_host())
+    grp_b = GroupedRoundEngine(mcfg, mesh)
+    grp_b.set_wire_resid(saved)
+    p_b = {k_: jnp.asarray(np.asarray(v)) for k_, v in p_a.items()}
+    p_b, pend = grp_b.train_superstep(p_b, HOST_KEY, 2, 1, sched[1:],
+                                      rates[1:], data)
+    pend.fetch()
+    grp_c = GroupedRoundEngine(mcfg, mesh)
+    p_c = model.init(jax.random.key(0))
+    for r in range(k):
+        p_c, pend = grp_c.train_superstep(p_c, HOST_KEY, 1 + r, 1,
+                                          sched[r:r + 1], rates[r:r + 1],
+                                          data)
+        pend.fetch()
+    _params_equal(p_c, p_b)
+
+
+def test_per_level_codec_map_single_psum_bind():
+    """The per-level payload rides ONE psum bind (the PR 2 invariant): count
+    the clients-axis psums in the traced fused superstep."""
+    from heterofl_tpu.staticcheck.jaxpr_walk import count_psum_over
+
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(4, 1)
+    model = make_model(cfg)
+    mcfg = dict(cfg, wire_codec=_level_map(cfg))
+    grp = GroupedRoundEngine(mcfg, mesh)
+    from heterofl_tpu.utils.optim import make_traced_lr_fn
+    grp._lr_fn = make_traced_lr_fn(mcfg)
+    params = model.init(jax.random.key(0))
+    prog = grp._superstep_prog(2, 2, "span")
+    n_dev = mesh.shape["clients"]
+    L = len(grp.levels)
+    resid_sds = jax.ShapeDtypeStruct(
+        grp._resid_shape(params), np.float32)
+    sched_sds = jax.ShapeDtypeStruct((2, L, 2 * n_dev), np.int32)
+    jaxpr = prog.trace(params, resid_sds, jax.random.key(0), np.int32(1),
+                       sched_sds, *data).jaxpr
+    assert count_psum_over(jaxpr, "clients") == 1
+
+
+def test_all_dense_map_collapses_to_dense():
+    from heterofl_tpu.compress import resolve_codec_cfg
+
+    name, ef = resolve_codec_cfg({"wire_codec": {"1.0": "dense",
+                                                 "0.5": "dense"}})
+    assert name == "dense"
+    with pytest.raises(ValueError, match="level key"):
+        resolve_codec_cfg({"wire_codec": {"a": "int8"}})
+    with pytest.raises(ValueError, match="assigned twice"):
+        # "1" and "1.0" coerce to the same rate: loud, never last-wins
+        resolve_codec_cfg({"wire_codec": {"1": "int8", "1.0": "dense"}})
+    with pytest.raises(ValueError, match="wire_codec for level"):
+        resolve_codec_cfg({"wire_codec": {"1.0": "zstd"}})
+
+
+def test_per_level_map_needs_grouped_engine_and_matching_levels():
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(4, 1)
+    model = make_model(cfg)
+    eng = RoundEngine(model, dict(cfg, wire_codec=_level_map(cfg)), mesh)
+    with pytest.raises(ValueError, match="grouped"):
+        eng.train_round(model.init(jax.random.key(0)), jax.random.key(1),
+                        0.05, np.array([0, 1]), data)
+    with pytest.raises(ValueError, match="level table"):
+        GroupedRoundEngine(dict(cfg, wire_codec={"1.0": "int8"}), mesh)
+
+
+# ---------------------------------------------------------------------------
+# driver integration: config plumbing + checkpointed carries + eval cohort
+# ---------------------------------------------------------------------------
+
+def _driver_cfg(tmp_path, **over):
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name("1_8_0.5_iid_fix_a1-b1_bn_1_1")
+    cfg["data_name"] = "MNIST"
+    cfg["model_name"] = "conv"
+    cfg["synthetic"] = True
+    cfg["synthetic_sizes"] = {"train": 80, "test": 40}
+    cfg["output_dir"] = str(tmp_path)
+    cfg["override"] = {"num_epochs": {"global": 4, "local": 1},
+                       "conv": {"hidden_size": [4, 8]},
+                       "batch_size": {"train": 10, "test": 20}, **over}
+    return C.process_control(cfg)
+
+
+def test_driver_scenario_run_and_resume_reproduce(tmp_path):
+    """End-to-end: a markov + deadline + buffered streaming run completes,
+    checkpoints its staleness buffer, and a resumed run finishes with the
+    exact params of an uninterrupted one."""
+    from heterofl_tpu.entry.common import FedExperiment
+
+    sched = {"kind": "markov",
+             "markov": {"p_on": 0.7, "p_off": 0.4, "length": 8, "seed": 1},
+             "deadline": {"min_frac": 0.4},
+             "aggregation": "buffered", "staleness": 0.5}
+    mk = lambda d: _driver_cfg(d, schedule=sched, client_store="stream",  # noqa: E731
+                               superstep_rounds=2, eval_interval=2)
+    full = FedExperiment(mk(tmp_path / "full"), 0).run("Global-Accuracy")
+
+    part_dir = tmp_path / "part"
+    cfg_p = mk(part_dir)
+    cfg_short = dict(cfg_p)
+    cfg_short["num_epochs"] = dict(cfg_p["num_epochs"], **{"global": 2})
+    FedExperiment(cfg_short, 0).run("Global-Accuracy")
+    cfg_res = dict(cfg_p)
+    cfg_res["resume_mode"] = 1
+    resumed = FedExperiment(cfg_res, 0).run("Global-Accuracy")
+    for k_ in full["params"]:
+        np.testing.assert_array_equal(np.asarray(full["params"][k_]),
+                                      np.asarray(resumed["params"][k_]),
+                                      err_msg=k_)
+
+
+def test_eval_cohort_validation(tmp_path):
+    from heterofl_tpu.entry.common import FedExperiment
+
+    with pytest.raises(ValueError, match="client_store='stream'"):
+        FedExperiment(_driver_cfg(tmp_path, eval_cohort=2), 0)
+    with pytest.raises(ValueError, match="eval_cohort"):
+        C.resolve_eval_cohort({"eval_cohort": 0})
+    with pytest.raises(ValueError, match="exceeds"):
+        C.resolve_eval_cohort({"eval_cohort": 9, "num_users": 8})
+    assert C.resolve_eval_cohort({"eval_cohort": None}) is None
+
+
+def test_eval_cohort_rolling_window_stages_o_cohort(tmp_path):
+    """Streaming + eval_cohort: the fused Local eval covers exactly the
+    rolling window (O(cohort), not O(population)), windows advance with the
+    eval cadence, and the >1e5-user warning path is retired (no warning
+    fires on this configuration)."""
+    from heterofl_tpu.entry.common import FedExperiment
+
+    cfg = _driver_cfg(tmp_path, client_store="stream", superstep_rounds=2,
+                      eval_interval=2, eval_cohort=3)
+    exp = FedExperiment(cfg, 0)
+    with warnings.catch_warnings():
+        # the satellite retires the O(U) local-eval warning on this path
+        warnings.filterwarnings("error",
+                                message="local eval stages every user")
+        out = exp.run("Global-Accuracy")
+    assert exp._fused is not None and exp._fused.n_users == 3
+    assert exp._eval_widx is not None
+    # windows roll deterministically over the population
+    assert exp._eval_cohort_users(1) == [3, 4, 5]
+    assert exp._eval_cohort_users(3) == [1, 2, 3]  # wraps mod num_users
+    hist = out["logger"].history
+    assert any(k_.startswith("test/") for k_ in hist)

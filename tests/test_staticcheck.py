@@ -336,9 +336,21 @@ def test_wire_memory_reshard_sections_on_every_program(audit_report):
         elif "/level-" in name:  # per-level partial: that level's slice
             rate = float(name.split("level-")[1].split("/")[0])
             assert p.wire["train_bytes_per_round"] == bt[rate]["wire_bytes"], name
+        elif name.endswith("-perlevel"):
+            # per-level codec map (ISSUE 9 satellite): the bind's payload is
+            # the per-level sum -- level-a under its codec, the rest dense
+            from heterofl_tpu.fed.core import level_codec_map_byte_table
+
+            cmap = {r: ("int8" if r == max(bt) else "dense") for r in bt}
+            expected = sum(level_codec_map_byte_table(
+                cfg, cmap, n_leaves=n_leaves).values())
+            assert p.wire["train_bytes_per_round"] == expected, name
         elif codec:  # compressed fused round: that codec's level-a payload
             assert p.wire["train_bytes_per_round"] == codec_wire[codec], name
-        else:  # every fused training round: the dense level-a reduction
+        else:  # every fused training round (incl. the ISSUE 9 trace/
+            # deadline/buffered scheduler variants -- selection arithmetic
+            # and post-psum buffering add no wire): the dense level-a
+            # reduction
             assert p.wire["train_bytes_per_round"] == level_a_wire, name
 
 
@@ -376,14 +388,15 @@ def test_auditor_flags_smuggled_io_callback(monkeypatch):
     orig = RoundEngine._round_core
 
     def smuggled(self, params, key, lr, user_loc, user_glob, data,
-                 resid=None):
-        new_p, ms, new_resid = orig(self, params, key, lr, user_loc,
-                                    user_glob, data, resid=resid)
+                 resid=None, sched_buf=None):
+        new_p, ms, new_resid, new_buf = orig(self, params, key, lr, user_loc,
+                                             user_glob, data, resid=resid,
+                                             sched_buf=sched_buf)
         # the smuggled host hook (e.g. a sneaky metrics push); the result is
         # discarded but the bind stays in the jaxpr, where the walk finds it
         _ = io_callback(lambda v: np.float32(0.0),
                         jax.ShapeDtypeStruct((), np.float32), lr)
-        return new_p, ms, new_resid
+        return new_p, ms, new_resid, new_buf
 
     monkeypatch.setattr(RoundEngine, "_round_core", smuggled)
     setup = build_setup()

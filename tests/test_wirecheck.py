@@ -177,14 +177,15 @@ def test_seeded_extra_psum_trips_wire_budget(setup, monkeypatch):
     orig = RoundEngine._round_core
 
     def doubled(self, params, key, lr, user_loc, user_glob, data,
-                resid=None):
-        new_p, ms, new_resid = orig(self, params, key, lr, user_loc,
-                                    user_glob, data, resid=resid)
+                resid=None, sched_buf=None):
+        new_p, ms, new_resid, new_buf = orig(self, params, key, lr, user_loc,
+                                             user_glob, data, resid=resid,
+                                             sched_buf=sched_buf)
         leak = jax.lax.psum(lr, "clients")  # the extra 4-byte global psum
         k0 = next(iter(new_p))
         new_p = dict(new_p)
         new_p[k0] = new_p[k0] + 0.0 * leak
-        return new_p, ms, new_resid
+        return new_p, ms, new_resid, new_buf
 
     monkeypatch.setattr(RoundEngine, "_round_core", doubled)
     name, prog, args, expect = _masked_targets(setup)[0]
@@ -230,15 +231,16 @@ def test_seeded_reshard_trips_detector(setup, monkeypatch):
     orig = RoundEngine._round_core
 
     def shifted(self, params, key, lr, user_loc, user_glob, data,
-                resid=None):
-        new_p, ms, new_resid = orig(self, params, key, lr, user_loc,
-                                    user_glob, data, resid=resid)
+                resid=None, sched_buf=None):
+        new_p, ms, new_resid, new_buf = orig(self, params, key, lr, user_loc,
+                                             user_glob, data, resid=resid,
+                                             sched_buf=sched_buf)
         n = self.mesh.shape["clients"]
         k0 = next(iter(new_p))
         new_p = dict(new_p)
         new_p[k0] = jax.lax.ppermute(
             new_p[k0], "clients", [(i, (i + 1) % n) for i in range(n)])
-        return new_p, ms, new_resid
+        return new_p, ms, new_resid, new_buf
 
     monkeypatch.setattr(RoundEngine, "_round_core", shifted)
     name, prog, args, expect = _masked_targets(setup)[0]
