@@ -72,6 +72,18 @@ the optimized-HLO scan-body kernel counts of the primary engine's hot
 program (cfg['fused_update'] on vs off; the staticcheck step-body budget
 gates the same counts).
 
+BENCH_TELEMETRY=1 (ISSUE 10): the runtime-telemetry A/B -- one measure
+with cfg['telemetry']='on' (in-program health probes riding the metrics
+fetch, a TraceRecorder writing trace.json + events.jsonl under
+BENCH_TRACE_DIR, default ./obs_trace) against one with telemetry off,
+recorded into extra.obs with the overhead percentage, the last round's
+probe record and the trace artifact path.  The watchdog (warn mode) runs
+over every fetched round's probes; if it FIRED the A/B is refused --
+extra.obs carries the trip evidence instead of on/off numbers, because a
+rounds/sec figure measured through a diverging run is not a telemetry
+overhead.  Needs BENCH_SUPERSTEP>1 for the grouped strategy; ignored in
+population mode (the A/B measures the eager flagship program).
+
 'value' is like-for-like across strategies: the average per-round seconds
 over timed rounds EXCLUDING rounds that compiled a fresh program shape
 (grouped slot-bucket compiles, superstep shape changes; detected via
@@ -909,6 +921,7 @@ def main():
         return summary, ctx
 
     step_ab = {}  # filled by the BENCH_STEP_AB pass; emitted when non-empty
+    obs_ab = {}   # filled by the BENCH_TELEMETRY pass; emitted when non-empty
 
     def emit(ctx, rounds_done, strategies=None):
         # a degraded (non-flagship-volume / wrong-platform) run must not
@@ -980,6 +993,7 @@ def main():
                       **scenario_extra,
                       **({"strategies": strategies} if strategies else {}),
                       **({"step_ab": step_ab} if step_ab else {}),
+                      **({"obs": obs_ab} if obs_ab else {}),
                       **({"degraded": degraded} if degraded else {})},
         }), flush=True)
 
@@ -1147,6 +1161,88 @@ def main():
         except Exception as e:
             step_ab.update({"error": repr(e)})
             print(f"bench: step A/B failed: {e!r}", file=sys.stderr)
+        emit(ctx, timed_rounds, strategies=strategies or None)
+
+    # BENCH_TELEMETRY=1 (ISSUE 10): the runtime-telemetry on-vs-off A/B --
+    # both arms measured with the SAME shared procedure; the ON arm carries
+    # the in-program health probes through every fetch, feeds them to a
+    # warn-mode watchdog, and records the run's Chrome trace.  A fired
+    # watchdog REFUSES the record: a rounds/sec number measured through a
+    # diverging run is not a telemetry overhead.
+    if os.environ.get("BENCH_TELEMETRY") == "1" and population:
+        print("bench: BENCH_TELEMETRY ignored in population mode (the A/B "
+              "measures the eager flagship program)", file=sys.stderr)
+    elif os.environ.get("BENCH_TELEMETRY") == "1" \
+            and strategy == "grouped" and superstep <= 1:
+        print("bench: BENCH_TELEMETRY with the grouped strategy needs "
+              "BENCH_SUPERSTEP>1 (the probes live in the fused superstep); "
+              "skipping the A/B", file=sys.stderr)
+    elif os.environ.get("BENCH_TELEMETRY") == "1":
+        try:
+            from heterofl_tpu.obs import resolve_telemetry_cfg, split_probes
+            from heterofl_tpu.obs.trace import TraceRecorder
+            from heterofl_tpu.obs.watchdog import Watchdog
+
+            trace_dir = os.environ.get("BENCH_TRACE_DIR") \
+                or os.path.join(os.getcwd(), "obs_trace")
+            rec = TraceRecorder(trace_dir)
+            tel_timer = PhaseTimer()
+            tel_timer.trace = rec  # phases file onto the run timeline
+            wd = Watchdog(resolve_telemetry_cfg({"telemetry": "on"}).watchdog)
+            tel_state = {"probes": None, "round": 0}
+
+            def tel_on_round(r, pending, ctx2):
+                with tel_timer.phase("fetch"):
+                    out = pending.fetch()
+                if isinstance(out, dict) and "train" in out:
+                    rounds_l, probes = out["train"], out.get("obs")
+                else:  # the K=1 train_round path: raw obs_ leaves in ms
+                    clean, probes = split_probes(out, len(devs))
+                    rounds_l = [clean]
+                ctx2["ms"] = rounds_l[-1]
+                for j, pr in enumerate(probes or []):
+                    msr = rounds_l[j] if j < len(rounds_l) else rounds_l[-1]
+                    n_j = float(np.asarray(msr["n"]).sum())
+                    loss_j = (float(np.asarray(msr["loss_sum"]).sum()) / n_j
+                              if n_j > 0 else None)
+                    tel_state["round"] += 1
+                    tel_state["probes"] = pr
+                    rec.instant("probes", cat="obs",
+                                args={"round": tel_state["round"],
+                                      "loss": loss_j, **pr})
+                    wd.check(tel_state["round"], probes=pr, loss=loss_j)
+
+            hb("[obs] telemetry on-vs-off A/B")
+            try:
+                on_sum, _on_ctx = measure(
+                    strategy, make_engine(strategy, {"telemetry": "on"}),
+                    model.init(jax.random.key(0)), tel_timer,
+                    hb_prefix="[obs/on] ", on_round=tel_on_round)
+            finally:
+                # a failed ON arm must still leave its trace on disk --
+                # that trace is the artifact that explains the failure
+                trace_path = rec.close()
+            off_sum, _ = measure(strategy, make_engine(strategy),
+                                 model.init(jax.random.key(0)), PhaseTimer(),
+                                 hb_prefix="[obs/off] ")
+            if wd.fired:
+                obs_ab.update({
+                    "error": "watchdog fired during the telemetry measure; "
+                             "refusing to record the on-vs-off A/B",
+                    "watchdog_fired": wd.fired[:8],
+                    "trace": trace_path})
+            else:
+                obs_ab.update({
+                    "on": on_sum, "off": off_sum,
+                    "overhead_pct": round(
+                        100.0 * (on_sum["round_sec_steady_avg"]
+                                 / off_sum["round_sec_steady_avg"] - 1.0), 2),
+                    "probes_last": tel_state["probes"],
+                    "watchdog_fired": [],
+                    "trace": trace_path})
+        except Exception as e:
+            obs_ab.update({"error": repr(e)})
+            print(f"bench: telemetry A/B failed: {e!r}", file=sys.stderr)
         emit(ctx, timed_rounds, strategies=strategies or None)
 
 

@@ -232,6 +232,26 @@ DEFAULT_CFG: Dict[str, Any] = {
     # Global eval still cover their full sets.  None = whole-population
     # local eval (the pre-scheduler behaviour, warned past 1e5 users).
     "eval_cohort": None,
+    # runtime telemetry (ISSUE 10, heterofl_tpu/obs/): "on" folds per-round
+    # health probes -- global grad/update norm, per-level participation,
+    # wire-codec residual norm, buffered staleness mass, a non-finite leaf
+    # counter -- into the fused round programs' metrics pytree, computed
+    # in-program from already-reduced values (ZERO new collectives; the
+    # staticcheck telemetry variants pin the same one-psum wire budget).
+    # "off" (default) builds bit-identical programs to the pre-obs engines.
+    # Needs a mesh-native strategy; the grouped engine needs the fused
+    # superstep (superstep_rounds > 1 or client_store='stream').
+    "telemetry": "off",
+    # watchdog knobs (telemetry='on' enables it at warn defaults): a dict
+    # {"action": "warn"|"abort"|"off", "spike_factor": 3.0, "window": 8} --
+    # non-finite params and loss-spikes-vs-rolling-median trip at fetch
+    # boundaries with a loud warning ("warn") or a WatchdogError ("abort").
+    "watchdog": None,
+    # run tracing (obs/trace.py): a directory to write a Chrome-trace-event
+    # trace.json (PhaseTimer phases + driver events + jax.profiler
+    # annotations; open in Perfetto) and a schema'd events.jsonl per run.
+    # None = no tracing.  Independent of the probes (host-side only).
+    "trace_dir": None,
     "profile_dir": None,  # write a jax.profiler trace of round 2 here
     "synthetic_sizes": None,  # {"train": n, "test": n} for synthetic data
     # Applied LAST by process_control: per-key overrides of any derived field
@@ -443,6 +463,11 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
     # user axis disagrees with num_users fail HERE, at config time
     resolve_schedule_cfg(cfg)
     resolve_eval_cohort(cfg)
+    # telemetry validation (ISSUE 10): unknown modes/watchdog knobs fail
+    # here, never as a silent telemetry-off fallback mid-run
+    from .obs import resolve_telemetry_cfg
+
+    resolve_telemetry_cfg(cfg)
     return cfg
 
 

@@ -17,6 +17,7 @@ import math
 import os
 import time
 import warnings
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -25,6 +26,8 @@ import numpy as np
 
 from .. import config as C
 from ..compress import resolve_codec_cfg
+from ..obs import resolve_telemetry_cfg, split_probes
+from ..obs.watchdog import Watchdog
 from ..data import (
     bptt_windows,
     stack_windows,
@@ -372,6 +375,29 @@ class FedExperiment:
                     "eval_cohort samples the per-user Local eval, which "
                     "only vision experiments run (LM evaluates Global "
                     "only)")
+        # runtime telemetry (ISSUE 10, heterofl_tpu/obs/): in-program health
+        # probes + watchdog + run tracing -- validated loudly here so a
+        # telemetry config that cannot run fails at construction
+        self.obs_spec = resolve_telemetry_cfg(cfg)
+        if self.obs_spec.probes:
+            if cfg.get("strategy") == "sliced":
+                raise ValueError(
+                    "telemetry='on' needs a mesh-native strategy ('masked' "
+                    "or 'grouped'): the sliced debug twin replays the "
+                    "reference host loop and has no in-program round core "
+                    "to probe")
+            if cfg.get("strategy") == "grouped" \
+                    and self.superstep_rounds <= 1 and not self.streaming:
+                raise ValueError(
+                    "telemetry='on' with the grouped strategy needs the "
+                    "fused superstep (superstep_rounds > 1 or client_store="
+                    "'stream'): the K=1 path splits the round across L+1 "
+                    "host-orchestrated programs with no shared round core "
+                    "to probe")
+        self.watchdog = Watchdog(self.obs_spec.watchdog) \
+            if (self.obs_spec.probes and self.obs_spec.watchdog is not None) \
+            else None
+        self.tracer = None  # obs.trace.TraceRecorder, built in run()
         self._eval_widx = None  # rolling Local-eval window currently staged
         self._fused = None  # FusedEval, built on first eval-bearing superstep
         self.alt_engine = None
@@ -730,7 +756,8 @@ class FedExperiment:
                 params, self.host_key, epoch0, k, timer=self.phase_timer,
                 eval_mask=mask if fused else None, fused_eval=fused,
                 lr=lr_const, cohort=cohort)
-            self._prefetch_cohort(epoch0 + k)
+            with self._trace_span("prefetch", {"epoch0": int(epoch0 + k)}):
+                self._prefetch_cohort(epoch0 + k)
         elif cfg.get("strategy") == "grouped":
             users = self._superstep_schedule(epoch0, k)
             rates = superstep_rate_schedule(self.host_key, epoch0, k, cfg,
@@ -768,17 +795,47 @@ class FedExperiment:
             self._log_superstep(logger, tag0, out)
         return params
 
+    def _trace_span(self, name: str, args: Optional[Dict[str, Any]] = None):
+        """A run-trace span (ISSUE 10) -- nullcontext when tracing is off,
+        so the driver's event sites cost nothing un-traced."""
+        if self.tracer is not None:
+            return self.tracer.span(name, cat="driver", args=args)
+        return nullcontext()
+
+    def _observe(self, logger: Logger, epoch: int, probes: Dict[str, Any],
+                 ms) -> None:
+        """Surface one fetched round's health probes (ISSUE 10): a
+        structured obs event on the run's JSONL, a trace instant, and the
+        watchdog check (loud warning or configurable abort).  This runs at
+        the FETCH boundary -- the first host code that sees the round."""
+        loss = None
+        n = float(np.sum(ms["n"]))
+        if n > 0:
+            loss = float(np.sum(ms["loss_sum"])) / n
+        logger.emit({"event": "probes", "epoch": int(epoch), "loss": loss,
+                     **probes})
+        if self.tracer is not None:
+            self.tracer.instant("probes", cat="obs",
+                                args={"epoch": int(epoch), "loss": loss,
+                                      **probes})
+        if self.watchdog is not None:
+            self.watchdog.check(epoch, probes=probes, loss=loss,
+                                emit=logger.emit)
+
     def _log_superstep(self, logger: Logger, tag: Dict[str, Any], out):
         """Log one (possibly deferred) superstep's rounds: train metrics per
         round, with each fused eval's Local/Global metrics logged right
         after the round it evaluated -- the K=1 host-loop ordering."""
         rounds = out["train"] if isinstance(out, dict) else out
-        evals = {e["epoch"]: e for e in out["eval"]} if isinstance(out, dict) else {}
+        evals = {e["epoch"]: e for e in (out.get("eval") or [])} \
+            if isinstance(out, dict) else {}
+        probes = out.get("obs") if isinstance(out, dict) else None
         per_round = tag["dt"] / tag["k"]
         for r in range(tag["k"]):
             epoch = tag["epoch0"] + r
             self._log_train_round(logger, epoch, tag["lrs"][r], per_round,
-                                  tag["phases"], rounds[r])
+                                  tag["phases"], rounds[r],
+                                  probes=probes[r] if probes else None)
             ev = evals.get(epoch)
             if ev is not None:
                 self._log_fused_eval(logger, epoch, ev)
@@ -812,8 +869,18 @@ class FedExperiment:
         return named_global
 
     def _log_train_round(self, logger: Logger, epoch: int, lr: float, dt: float,
-                         phases: Dict[str, float], ms: Dict[str, np.ndarray]):
-        """Log one (possibly deferred) round's train metrics + info lines."""
+                         phases: Dict[str, float], ms: Dict[str, np.ndarray],
+                         probes: Optional[Dict[str, Any]] = None):
+        """Log one (possibly deferred) round's train metrics + info lines.
+
+        ``probes``: this round's assembled health-probe record (superstep
+        fetches carry it pre-split); the K=1 ``train_round`` path still has
+        the raw ``obs_*`` leaves riding the metrics dict and splits them
+        here, at the fetch boundary."""
+        if probes is None and self.obs_spec.probes:
+            ms, plist = split_probes(ms, self.mesh.shape["clients"])
+            if plist:
+                probes = plist[0]
         named = summarize_sums(ms, self.cfg["model_name"])
         logger.append(named, "train", n=float(ms["n"].sum()))
         mean_dt = float(np.mean(self._round_times)) if self._round_times else dt
@@ -829,6 +896,8 @@ class FedExperiment:
                          f"Experiment Finished Time: {eta}"]}
         logger.append(info, "train", mean=False)
         logger.write("train", list(named))
+        if probes is not None:
+            self._observe(logger, epoch, probes, ms)
 
     def _drain_metrics(self, logger: Logger):
         """Flush the async metric pipeline (checkpoint/eval boundaries)."""
@@ -902,6 +971,16 @@ class FedExperiment:
         last_epoch = 1
         logger = Logger(os.path.join(cfg["output_dir"], "runs", f"train_{self.tag}"),
                         use_tensorboard=bool(cfg.get("use_tensorboard")))
+        if self.obs_spec.trace_dir and self.tracer is None \
+                and jax.process_index() == 0:
+            # run tracing (ISSUE 10): one Chrome-trace + events-JSONL
+            # recorder per run; PhaseTimer phases file onto the same
+            # timeline, driver events land via _trace_span below
+            from ..obs.trace import TraceRecorder
+
+            self.tracer = TraceRecorder(
+                os.path.join(self.obs_spec.trace_dir, self.tag))
+            self.phase_timer.trace = self.tracer
         pivot = -float("inf") if pivot_mode == "max" else float("inf")
         if blob:
             params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
@@ -929,6 +1008,24 @@ class FedExperiment:
         n_rounds = cfg["num_epochs"]["global"]
         eval_interval = self.eval_interval
         epoch = last_epoch
+        if self.tracer is not None:
+            self.tracer.instant("run-start",
+                                args={"tag": self.tag, "epoch0": int(epoch),
+                                      "rounds": int(n_rounds)})
+        try:
+            return self._run_loop(logger, pivot_metric, pivot_mode, pivot,
+                                  epoch, n_rounds, eval_interval, data_split,
+                                  label_split, params)
+        finally:
+            if self.tracer is not None:
+                # the trace must survive aborts (the watchdog's whole
+                # point): close on every exit path
+                self.tracer.close()
+                self.phase_timer.trace = None
+
+    def _run_loop(self, logger, pivot_metric, pivot_mode, pivot, epoch,
+                  n_rounds, eval_interval, data_split, label_split, params):
+        cfg = self.cfg
         while epoch <= n_rounds:
             logger.safe(True)
             # superstep length: the end of the run is the ONLY clamp left --
@@ -944,7 +1041,9 @@ class FedExperiment:
                 k_eff = min(self.superstep_rounds, n_rounds - epoch + 1)
                 # a clamped end-of-run tail still goes through the superstep
                 # path (smaller k) so ONE sampling stream covers the run
-                params = self.train_superstep(params, epoch, k_eff, logger)
+                with self._trace_span("superstep",
+                                      {"epoch0": int(epoch), "k": int(k_eff)}):
+                    params = self.train_superstep(params, epoch, k_eff, logger)
                 epoch = epoch + k_eff - 1  # last round this iteration covered
                 # pivot integrity: the checkpoint below holds END-OF-SUPERSTEP
                 # params, so only an eval on the boundary round -- fetched
@@ -957,10 +1056,12 @@ class FedExperiment:
             else:
                 pivot_fresh = True
                 lr = self.scheduler(epoch)
-                params = self.train_round(params, epoch, lr, logger)
+                with self._trace_span("round", {"epoch": int(epoch)}):
+                    params = self.train_round(params, epoch, lr, logger)
                 evaluated = epoch % eval_interval == 0 or epoch == n_rounds
                 if evaluated:
-                    self.evaluate(params, epoch, logger, label_split)
+                    with self._trace_span("eval", {"epoch": int(epoch)}):
+                        self.evaluate(params, epoch, logger, label_split)
                     if isinstance(self.scheduler, PlateauScheduler):
                         # min-mode plateau fed the test Global loss, only on
                         # rounds that actually evaluated.  (The reference
@@ -1000,9 +1101,11 @@ class FedExperiment:
             # writes (every host writing the same file corrupts shared
             # filesystems; harmless no-op on a single host)
             if jax.process_index() == 0:
-                save_checkpoint(checkpoint_path(cfg["output_dir"], self.tag), blob_out)
-                if is_best:
-                    copy_best(cfg["output_dir"], self.tag)
+                with self._trace_span("checkpoint", {"epoch": int(epoch)}):
+                    save_checkpoint(checkpoint_path(cfg["output_dir"], self.tag),
+                                    blob_out)
+                    if is_best:
+                        copy_best(cfg["output_dir"], self.tag)
             logger.reset()
             epoch += 1
         self._drain_metrics(logger)  # safety: nothing stays on device at exit

@@ -1,0 +1,93 @@
+"""In-program health probes (ISSUE 10, the jax half).
+
+One function: :func:`round_probes`, called at the END of a fused round's
+in-jit core -- after the single global psum and the counted-average
+combine -- on quantities the scan already holds.  The hard constraint is
+ZERO new collectives (staticcheck pins the telemetry-on program variants
+at the same one-psum budget and the same wire bytes as their dense
+twins), so every probe is one of:
+
+* **derived from already-reduced values**: the post-psum aggregates
+  (``summed``/``counts``) and the params carry are replicated, so norms
+  over them are global without any exchange -- the global grad norm
+  (counted-average client delta), the update norm (new - old params), the
+  buffered staleness mass, and the non-finite leaf counter;
+* **a per-device PARTIAL** the host finishes at fetch time: per-level
+  participation counts and the error-feedback residual sum-of-squares are
+  emitted per device, concatenated by the existing metrics out-spec, and
+  summed on the host (:func:`~heterofl_tpu.obs.split_probes`).
+
+Probe leaves ride the engines' existing metrics pytree (keys prefixed
+``obs_``), stack over the superstep scan like every other metric, and
+cross to the host in the one per-superstep fetch -- no extra dispatches,
+no host callbacks, no new program arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _sq_norm(tree: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Squared L2 norm over a params-shaped tree (f32 scalar)."""
+    return sum(jnp.sum(jnp.square(v)) for v in tree.values())
+
+
+def round_probes(levels: Sequence[float], params: Dict[str, jnp.ndarray],
+                 new_params: Dict[str, jnp.ndarray],
+                 summed: Dict[str, jnp.ndarray],
+                 counts: Dict[str, jnp.ndarray], rate_ms: jnp.ndarray,
+                 resid: Optional[jnp.ndarray] = None,
+                 sched_buf: Optional[jnp.ndarray] = None,
+                 ) -> Dict[str, jnp.ndarray]:
+    """One round's probe leaves, shaped as rank-1 per-device rows.
+
+    ``params``/``new_params``: the (replicated) carry before/after the
+    combine; ``summed``/``counts``: the POST-psum aggregates (dequantised
+    under a wire codec); ``rate_ms``: the per-slot ``rate * valid`` metric
+    the engines already emit (its nonzeros ARE this device's valid
+    participants, level by level); ``resid``: this device's new
+    error-feedback carry (lossy codecs; None under dense); ``sched_buf``:
+    the new replicated staleness buffer (buffered-async only).
+
+    Probes (keys are ``obs_``-prefixed; shapes per device):
+
+    * ``obs_update_sq`` ``[1]`` -- squared norm of the applied global
+      update ``new - old`` (replicated);
+    * ``obs_grad_sq`` ``[1]`` -- squared norm of the counted-average
+      client delta ``(summed - old*counts)/max(counts,1)``, the round's
+      pseudo-gradient.  Equal to ``obs_update_sq`` under dense synchronous
+      aggregation (the stale rule zeroes both where no client
+      contributed); under a lossy codec it measures the DEQUANTISED
+      aggregate and under buffering the in-flight cohort, which is exactly
+      why both exist;
+    * ``obs_part`` ``[L]`` -- per-level valid-participant counts, a
+      per-device partial (host sums devices);
+    * ``obs_resid_sq`` ``[1]`` -- this device's EF-residual sum of squares
+      (partial; zeros under dense);
+    * ``obs_stale_sq`` ``[1]`` -- squared norm of the pending buffered
+      update rows (replicated; zeros under sync aggregation);
+    * ``obs_nonfinite`` ``[1]`` -- number of new-params leaves containing
+      ANY non-finite element (replicated f32 count).
+    """
+    upd = _sq_norm({k: new_params[k] - params[k] for k in params})
+    grad = _sq_norm({k: (summed[k] - params[k] * counts[k])
+                     / jnp.maximum(counts[k], 1.0) for k in params})
+    part = jnp.stack([jnp.sum((rate_ms == jnp.float32(lvl))
+                              .astype(jnp.float32)) for lvl in levels])
+    nonfinite = sum(jnp.any(~jnp.isfinite(v)).astype(jnp.float32)
+                    for v in new_params.values())
+    resid_sq = jnp.zeros(()) if resid is None else jnp.sum(jnp.square(resid))
+    stale_sq = jnp.zeros(()) if sched_buf is None \
+        else jnp.sum(jnp.square(sched_buf))
+    return {
+        "obs_update_sq": jnp.reshape(upd, (1,)),
+        "obs_grad_sq": jnp.reshape(grad, (1,)),
+        "obs_part": part,
+        "obs_resid_sq": jnp.reshape(resid_sq, (1,)),
+        "obs_stale_sq": jnp.reshape(stale_sq, (1,)),
+        "obs_nonfinite": jnp.reshape(nonfinite, (1,)),
+    }

@@ -69,6 +69,8 @@ from ..fed.core import (combine_counted, embed_sliced_jnp, extract_sliced_jnp,
 from ..models import make_model
 from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
+from ..obs import resolve_telemetry_cfg, split_probes
+from ..obs.probes import round_probes
 from ..ops.fused_update import FlatSpec
 from ..sched import resolve_schedule_cfg
 from ..sched.buffer import _SchedBufCarry, buffered_combine
@@ -170,6 +172,15 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                 "schedule aggregation='buffered' cannot combine with a "
                 "lossy wire_codec yet: both add a scan carry with its own "
                 "donation/checkpoint contract -- pick one per experiment")
+        # runtime telemetry (ISSUE 10): probes live in the fused superstep
+        # (where the round's single psum and the combined globals are);
+        # the K=1 host-orchestrated path refuses loudly in train_round
+        self._obs_spec = resolve_telemetry_cfg(cfg)
+        self._obs_on = self._obs_spec.probes
+        # staticcheck: allow(no-float-coercion): constructor-time config
+        # parse (the probe level table, a trace-time constant)
+        self._obs_levels = sorted({float(r) for r in cfg["model_rate"]},
+                                  reverse=True)
         if self.level_placement == "slices":
             if jax.process_count() > 1:
                 # slice boundaries are not host-aligned yet: a level whose
@@ -469,6 +480,13 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                 "superstep (set superstep_rounds > 1 or client_store="
                 "'stream'): the K=1 host-orchestrated path combines in its "
                 "own program and has no scan carry to buffer")
+        if self._obs_on:
+            raise ValueError(
+                "telemetry='on' with the grouped strategy needs the fused "
+                "superstep (set superstep_rounds > 1 or client_store="
+                "'stream'): the K=1 path splits the round across L+1 "
+                "host-orchestrated programs with no shared round core to "
+                "probe")
         timer = timer if timer is not None else PhaseTimer()
         n_dev = self.mesh.shape["clients"]
         with timer.phase("stage"):
@@ -659,6 +677,22 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                 data = rest[idx + 1:idx + 1 + n_data_args]
                 eval_ops = rest[idx + 1 + n_data_args:]
 
+            def attach_probes(ms_, p_old, new_p_, tot_s_, tot_c_, nr_=None,
+                              nb_=None):
+                """Fold the in-program health probes into the metrics tree
+                (ISSUE 10): post-psum aggregates + the combined globals,
+                zero new collectives.  Identity under telemetry='off'."""
+                if not self._obs_on:
+                    return ms_
+                pr = round_probes(self._obs_levels, p_old, new_p_, tot_s_,
+                                  tot_c_, ms_["rate"], resid=nr_,
+                                  sched_buf=nb_)
+                if mode == "span":
+                    # span metric leaves are [L, slots]: rank-pad the probe
+                    # rows so the one broadcast out-spec covers the tree
+                    pr = {n: v[:, None] for n, v in pr.items()}
+                return {**ms_, **pr}
+
             def step(carry, xs):
                 if codec:
                     p, rs, sb = carry[0], carry[1], None
@@ -729,6 +763,7 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                         tot_c = c_e if tot_c is None else \
                             {n: tot_c[n] + c_e[n] for n in tot_c}
                     new_p = combine_counted(p, tot_s, tot_c)
+                    ms = attach_probes(ms, p, new_p, tot_s, tot_c, nr_=nr)
                     return (new_p, nr), ms
                 if mode == "span":
                     # srow: [L, per_dev] -- this device's slots of EVERY level
@@ -784,8 +819,11 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                     new_p, nb = buffered_combine(p, sb, tot_s, tot_c,
                                                  FlatSpec.of(p),
                                                  self._sched_spec.staleness)
+                    ms = attach_probes(ms, p, new_p, tot_s, tot_c, nb_=nb)
                     return (new_p, nb), ms
                 new_p = combine_counted(p, tot_s, tot_c)
+                ms = attach_probes(ms, p, new_p, tot_s, tot_c,
+                                   nr_=nr if codec else None)
                 return ((new_p, nr) if codec else new_p), ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
@@ -1073,6 +1111,15 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
             self._sched_buf = out[1]
             out = (out[0],) + out[2:]
 
+        def _split(host):
+            """Probe leaves out of a fetched metrics tree (ISSUE 10):
+            telemetry-off trees pass through untouched (None probes)."""
+            if self._obs_on:
+                return split_probes(host, self.mesh.shape["clients"],
+                                    layout="span" if mode == "span"
+                                    else "flat")
+            return host, None
+
         def _assemble_train(host):
             rounds = []
             for r in range(k):
@@ -1092,14 +1139,26 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
 
         if eval_mask is None:
             new_params, ms = out
-            return new_params, PendingMetrics(ms, assemble=_assemble_train)
+
+            def _assemble(host):
+                host, probes = _split(host)
+                rounds = _assemble_train(host)
+                if probes is not None:
+                    return {"train": rounds, "obs": probes}
+                return rounds
+
+            return new_params, PendingMetrics(ms, assemble=_assemble)
 
         new_params, ms, ev = out
         eval_epochs = [epoch0 + r for r, m in enumerate(eval_mask) if m]
 
         def _assemble_eval(host):
             ms_h, ev_h = host
-            return {"train": _assemble_train(ms_h),
-                    "eval": fused_eval.assemble(ev_h, eval_epochs)}
+            ms_h, probes = _split(ms_h)
+            out_d = {"train": _assemble_train(ms_h),
+                     "eval": fused_eval.assemble(ev_h, eval_epochs)}
+            if probes is not None:
+                out_d["obs"] = probes
+            return out_d
 
         return new_params, PendingMetrics((ms, ev), assemble=_assemble_eval)

@@ -37,6 +37,8 @@ except ImportError:  # pragma: no cover
 
 from ..compress import make_codec, resid_slots, resolve_codec_cfg
 from ..config import resolve_prefetch_depth
+from ..obs import resolve_telemetry_cfg, split_probes
+from ..obs.probes import round_probes
 from ..data.datasets import DATASET_STATS
 from ..fed.core import combine_counted, round_rates, round_users
 from ..sched import resolve_schedule_cfg
@@ -353,6 +355,16 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         # default builds byte-identical programs (zero new carry args).
         self._sched_spec = resolve_schedule_cfg(cfg)
         self._sched_buf = None  # device [2, total] staleness carry
+        # runtime telemetry (ISSUE 10, heterofl_tpu/obs/): telemetry='on'
+        # folds the in-program health probes into the metrics pytree of
+        # every round core -- zero new collectives, zero new arguments;
+        # 'off' (default) leaves every program bit-identical to pre-obs.
+        self._obs_spec = resolve_telemetry_cfg(cfg)
+        self._obs_on = self._obs_spec.probes
+        # staticcheck: allow(no-float-coercion): constructor-time config
+        # parse (the probe level table, a trace-time constant)
+        self._obs_levels = sorted({float(r) for r in cfg["model_rate"]},
+                                  reverse=True)
         if self._sched_spec.buffered and self._codec_name != "dense":
             raise ValueError(
                 "schedule aggregation='buffered' cannot combine with a "
@@ -800,6 +812,15 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             new_buf = None
         ms = {k: v * valid for k, v in ms.items()}
         ms["rate"] = rates_abs * valid
+        if self._obs_on:
+            # in-program health probes (ISSUE 10): derived from the
+            # already-reduced aggregates and the replicated carries --
+            # ZERO new collectives (the staticcheck telemetry variants pin
+            # the same one-psum budget and the same wire bytes); per-device
+            # partials ride the metrics out-spec and finish on the host
+            ms = {**ms, **round_probes(self._obs_levels, params, new_params,
+                                       summed, counts, ms["rate"],
+                                       resid=new_resid, sched_buf=new_buf)}
         return new_params, ms, new_resid, new_buf
 
     def _data_specs(self) -> Tuple[P, ...]:
@@ -1307,11 +1328,26 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         elif self._sched_spec.buffered:
             self._sched_buf = out[1]
             out = (out[0],) + out[2:]
+        n_dev = self.mesh.shape["clients"]
+        obs_on = self._obs_on
+
+        def _split(host):
+            """Probe leaves out of a fetched metrics tree (ISSUE 10):
+            telemetry-off trees pass through untouched (None probes)."""
+            if obs_on:
+                return split_probes(host, n_dev)
+            return host, None
+
         if eval_mask is None:
             new_params, ms = out
 
             def _assemble(host):
-                return [{name: v[r] for name, v in host.items()} for r in range(k)]
+                host, probes = _split(host)
+                rounds = [{name: v[r] for name, v in host.items()}
+                          for r in range(k)]
+                if probes is not None:
+                    return {"train": rounds, "obs": probes}
+                return rounds
 
             return new_params, PendingMetrics(ms, assemble=_assemble)
 
@@ -1320,9 +1356,13 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
 
         def _assemble_eval(host):
             ms_h, ev_h = host
-            return {"train": [{name: v[r] for name, v in ms_h.items()}
-                              for r in range(k)],
-                    "eval": fused_eval.assemble(ev_h, eval_epochs)}
+            ms_h, probes = _split(ms_h)
+            out_d = {"train": [{name: v[r] for name, v in ms_h.items()}
+                               for r in range(k)],
+                     "eval": fused_eval.assemble(ev_h, eval_epochs)}
+            if probes is not None:
+                out_d["obs"] = probes
+            return out_d
 
         return new_params, PendingMetrics((ms, ev), assemble=_assemble_eval)
 

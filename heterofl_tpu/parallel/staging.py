@@ -247,11 +247,17 @@ class PhaseTimer:
     placement-cache lookups), ``dispatch`` (program calls returning) and
     ``fetch`` (D2H metric assembly); bench.py adds ``compute``
     (block_until_ready).  Cheap enough to leave always on.
+
+    ``trace`` (ISSUE 10): attach an :class:`~..obs.trace.TraceRecorder`
+    and every finished phase is ALSO filed as a complete event on the
+    run's Chrome-trace timeline -- the phase table and the trace share one
+    measurement (and one clock: ``perf_counter``).
     """
 
     def __init__(self):
         self.totals: Dict[str, float] = {}
         self.calls: Dict[str, int] = {}
+        self.trace = None  # optional obs.trace.TraceRecorder
 
     @contextmanager
     def phase(self, name: str):
@@ -264,6 +270,8 @@ class PhaseTimer:
             dt = time.perf_counter() - t0  # staticcheck: allow(no-wallclock): host-side phase accounting
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.calls[name] = self.calls.get(name, 0) + 1
+            if self.trace is not None:
+                self.trace.complete(name, t0, dt, cat="phase")
 
     def snapshot(self) -> Dict[str, float]:
         return dict(self.totals)

@@ -39,7 +39,11 @@ statically enforces:
     residual carry is the ONLY donated input (both engines pin resid-only
     donation around an XLA:CPU executable-serialization bug; see
     parallel.round_engine._WireCodecCarry), and the analytic flagship int8
-    payload stays <= 25% of the dense baseline (``wire-frontier``).
+    payload stays <= 25% of the dense baseline (``wire-frontier``);
+(j) **telemetry** (ISSUE 10, :mod:`..obs`) -- the ``telemetry='on'``
+    program variants carry the in-program health probes at ZERO wire cost:
+    same single global psum, same wire bytes by equality, full donation,
+    and the k1 step body inside the unchanged kernel budget.
 
 Widths: the default audit config keeps the flagship *structure* (5-level
 a1-e1 fix mix, both engines, both placements, K in {1, 8}) at test-scale
@@ -98,6 +102,10 @@ EVAL_PSUM_BUDGET = 2
 STEP_BODY_FUSION_BUDGET = {
     "masked/replicated/k1": 60,
     "grouped/span/level-1/k1": 66,
+    # ISSUE 10: the health probes live at ROUND level (post-psum), never
+    # inside the local-step scan body -- the telemetry-on k1 program is
+    # held to the SAME step-body budget as its dense twin
+    "masked/replicated/k1-telemetry": 60,
 }
 
 
@@ -730,6 +738,88 @@ def _sched_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
     return targets
 
 
+def _obs_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
+    """Telemetry variants (ISSUE 10): ``telemetry='on'`` folds the health
+    probes into the metrics pytree of every round core, and these targets
+    pin the zero-cost contract statically -- SAME single global psum, SAME
+    dense (or codec) wire bytes by equality (the probes derive from
+    already-reduced values and per-device partials, never a new
+    collective), full params donation, and the k1 program held to the
+    unchanged step-body kernel budget (the probes live outside the
+    local-step scan).  The int8 variant proves the probe of the
+    error-feedback residual rides the codec programs without touching
+    their resid-only donation policy or compressed payload."""
+    import jax
+
+    from ..compress import resid_slots
+    from ..fed.core import level_codec_byte_table
+    from ..ops.fused_update import FlatSpec
+    from ..parallel import GroupedRoundEngine, RoundEngine
+    from ..parallel.grouped import _bucket_pow2
+    from ..utils.optim import make_traced_lr_fn
+
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    params, key, lr = setup["params"], setup["key"], setup["lr"]
+    users = setup["users"]
+    n_dev = mesh.shape["clients"]
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    bt = setup["byte_table"]
+    top = max(bt)
+    wire = bt[top]["wire_bytes"]
+    k = 8
+    a = int(math.ceil(cfg["frac"] * users))
+    per_dev = _ceil_div(a, n_dev)
+    per_level = 2
+    per_dev_g = _bucket_pow2(_ceil_div(per_level, n_dev))
+    targets = []
+
+    def mem(cpd: int) -> Dict[str, int]:
+        return _mem_expect(bt, top, cpd)
+
+    tcfg = dict(cfg, telemetry="on")
+    eng = RoundEngine(model, tcfg, mesh)
+    eng._lr_fn = make_traced_lr_fn(cfg)
+    fix = (eng.fix_rates,) if eng.fix_rates is not None else ()
+    data = tuple(setup["data"]) + fix
+    slots = users + ((-users) % n_dev)
+    targets.append((
+        "masked/replicated/k1-telemetry", eng._build_train(),
+        (params, key, lr, _sds((slots,)), _sds((slots,))) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(_ceil_div(slots, n_dev))}))
+    targets.append((
+        "masked/replicated/k8-telemetry",
+        eng._build_superstep(k, per_dev, True, num_active=a),
+        (params, key, np.int32(1)) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev)}))
+
+    grp = GroupedRoundEngine(tcfg, mesh)
+    grp._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "grouped/span/k8-fused-telemetry",
+        grp._superstep_prog(k, per_dev_g, "span"),
+        (params, key, np.int32(1),
+         _sds((k, len(grp.levels), per_dev_g * n_dev))) + data[:4],
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev_g)}))
+
+    total = FlatSpec.of(params).total
+    ceng = RoundEngine(model, dict(cfg, telemetry="on", wire_codec="int8"),
+                       mesh)
+    ceng._lr_fn = make_traced_lr_fn(cfg)
+    wire_i8 = level_codec_byte_table(cfg, "int8", n_leaves=n_leaves)[top]
+    resid_bytes = n_dev * resid_slots("int8") * total * 4
+    targets.append((
+        "masked/replicated/k8-telemetry-int8",
+        ceng._build_superstep(k, per_dev, True, num_active=a),
+        (params, _sds((n_dev, resid_slots("int8"), total), np.float32), key,
+         np.int32(1)) + data,
+        {"donated": 1, "psum": PSUM_BUDGET, "wire_bytes": wire_i8,
+         "donated_bytes": resid_bytes, "mem": mem(per_dev)}))
+    return targets
+
+
 def codec_frontier_check(report: "AuditReport") -> Dict[str, Any]:
     """The analytic flagship compression frontier (ISSUE 8 acceptance): each
     codec's per-round payload at full CIFAR-10 ResNet-18 widths vs the
@@ -1124,6 +1214,7 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
     targets.extend(grouped)
     targets.extend(_codec_targets(setup))
     targets.extend(_sched_targets(setup))
+    targets.extend(_obs_targets(setup))
     for name, prog, args, expect in targets:
         report.add_program(audit_program(name, prog, args, expect, mesh))
 

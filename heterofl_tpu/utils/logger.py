@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import warnings
 from collections import defaultdict
 from numbers import Number
 from typing import Dict, Iterable, List, Optional
@@ -22,6 +23,7 @@ class Logger:
         self.use_tensorboard = use_tensorboard
         self.writer = None
         self._jsonl = None
+        self._tb_warned = False
         self.tracker: Dict[str, object] = {}
         self.counter: Dict[str, float] = defaultdict(float)
         self.mean: Dict[str, float] = defaultdict(float)
@@ -38,7 +40,17 @@ class Logger:
                     from torch.utils.tensorboard import SummaryWriter
 
                     self.writer = SummaryWriter(self.log_path)
-                except Exception:
+                except Exception as e:
+                    # ISSUE 10 satellite: the bare except used to swallow
+                    # this silently -- an operator asking for tensorboard
+                    # got JSONL-only logging with no hint why.  One warning
+                    # per Logger, then the degraded mode proceeds as before.
+                    if not self._tb_warned:
+                        self._tb_warned = True
+                        warnings.warn(
+                            f"use_tensorboard=True but the tensorboard "
+                            f"writer is unavailable ({e!r}); continuing "
+                            f"with JSONL-only logging")
                     self.writer = None
         else:
             if self.writer is not None:
@@ -119,6 +131,18 @@ class Logger:
             self._jsonl.write(json.dumps(record) + "\n")
             self._jsonl.flush()
         return line
+
+    def emit(self, event: Dict[str, object]) -> None:
+        """Structured obs event on the existing JSONL writer (ISSUE 10):
+        one ``{"tag": "obs", "t": ..., **event}`` line next to the metric
+        records, so probe snapshots and watchdog trips land in the same
+        ``log.jsonl`` a run already produces.  No-op while the writer is
+        closed (outside a ``safe(True)`` window) -- obs events are
+        advisory, never worth crashing a checkpoint boundary over."""
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps({"tag": "obs", "t": time.time(),
+                                          **event}) + "\n")
+            self._jsonl.flush()
 
     def flush(self) -> None:
         if self.writer is not None:
