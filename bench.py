@@ -43,6 +43,17 @@ staleness-weighted aggregation (needs BENCH_SUPERSTEP>1).  Scenario runs
 draw cohorts host-side through the one sampling stream and record
 per-round participation stats + rounds/sec into extra.scenario.
 
+BENCH_SAMPLER=prp|perm (ISSUE 11): the population sampler behind the one
+sampling stream (cfg['sampler'], heterofl_tpu/fed/sampling.py) -- 'prp'
+(default) is the O(active) pseudorandom-permutation index-map draw, 'perm'
+the legacy full-permutation stream.  Every record carries extra.sampler: the
+kind plus a host draw microbench of BOTH samplers at this run's population
+(seconds per [1, A] schedule draw, prp-vs-perm speedup) -- at
+BENCH_POPULATION=1e6 this is the O(U log U) -> O(active) acceptance
+measurement.  The two samplers are DIFFERENT streams: the bench refuses to
+record against a newest BENCH_r*.json drawn under the other sampler unless
+BENCH_ALLOW_STREAM_CHANGE=1 (trajectory re-baseline must be deliberate).
+
 BENCH_POPULATION=N (ISSUE 6): a population axis.  The federation grows to N
 synthetic users (up to 1e6) WITHOUT densifying per-user stacks: users window
 onto the shared synthetic sample pool via data.partition.span_population
@@ -184,6 +195,35 @@ def _load_staticcheck():
                            if ratchet.get("checked") else None),
             "ratchet_regressions": len(ratchet.get("regressions") or []),
             "program_temp_bytes": {k: v for k, v in mem.items() if v}}
+
+
+def _latest_bench_record():
+    """The newest committed BENCH_r*.json (by round number), or None: the
+    baseline the sampling-stream comparability gate (ISSUE 11) checks this
+    run's sampler kind against.  The loaded record carries its path under
+    ``_path`` for the refusal message."""
+    import re
+
+    best, best_n = None, -1
+    try:
+        names = os.listdir(_REPO)
+    except OSError:
+        return None
+    for fn in names:
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", fn)
+        if m and int(m.group(1)) > best_n:
+            best_n, best = int(m.group(1)), fn
+    if best is None:
+        return None
+    try:
+        with open(os.path.join(_REPO, best)) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(rec, dict):
+        return None
+    rec["_path"] = best
+    return rec
 
 
 def _force_cpu():
@@ -404,6 +444,39 @@ def main():
         }), flush=True)
         return
 
+    # sampling-stream comparability gate (ISSUE 11): a prp record landing
+    # next to a perm baseline (or vice versa) compares two different seeded
+    # trajectories as if they were one series -- refuse unless the operator
+    # explicitly acknowledges the re-baseline.  Records before ISSUE 11
+    # carry no extra.sampler and drew the legacy permutation stream.
+    sampler_kind = os.environ.get("BENCH_SAMPLER", "") or "prp"
+    if sampler_kind not in ("perm", "prp"):
+        print(f"bench: ignoring unknown BENCH_SAMPLER={sampler_kind!r} "
+              f"(one of perm|prp)", file=sys.stderr)
+        sampler_kind = "prp"
+    prev_bench = _latest_bench_record()
+    if prev_bench is not None \
+            and os.environ.get("BENCH_ALLOW_STREAM_CHANGE") != "1":
+        prev_kind = ((prev_bench.get("extra") or {}).get("sampler") or {}) \
+            .get("kind", "perm")
+        if prev_kind != sampler_kind:
+            print(json.dumps({
+                "metric": "federated_rounds_per_sec_cifar10_resnet18_a1-e1_100c",
+                "value": 0.0, "unit": "rounds/sec", "vs_baseline": None,
+                "extra": {"error": f"sampling-stream change: this run draws "
+                                   f"sampler={sampler_kind!r} but the newest "
+                                   f"committed bench record "
+                                   f"({prev_bench.get('_path')}) was drawn "
+                                   f"under {prev_kind!r} -- every seeded "
+                                   f"trajectory differs, so the records are "
+                                   f"not comparable.  Set "
+                                   f"BENCH_ALLOW_STREAM_CHANGE=1 to record "
+                                   f"the deliberate re-baseline.",
+                          "sampler": {"kind": sampler_kind,
+                                      "previous_kind": prev_kind}},
+            }), flush=True)
+            return
+
     hb("claiming devices")
     devs = jax.devices()  # first touch claims the tunnel -- the wedge point
     platform = devs[0].platform
@@ -431,6 +504,7 @@ def main():
     cfg["control"] = C.parse_control_name(f"1_{users}_0.1_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
     cfg["data_name"] = "CIFAR10"
     cfg["model_name"] = "resnet18"
+    cfg["sampler"] = sampler_kind  # ISSUE 11 (validated by process_control)
     cfg["synthetic"] = True
     # bf16 matmul/conv operands with f32 accumulation: the TPU MXU recipe.
     cfg["compute_dtype"] = os.environ.get("BENCH_DTYPE", "bfloat16")
@@ -707,16 +781,49 @@ def main():
     pipe = MetricsPipeline(fetch_every)
     base_key = jax.random.key(0)
 
+    # sampler microbench (ISSUE 11): the host draw cost of ONE [1, A] round
+    # schedule under BOTH samplers at THIS run's population, through the
+    # very stream the run consumes (fed.core.superstep_user_schedule).  At
+    # BENCH_POPULATION=1e6 this is the acceptance measurement: perm pays
+    # the O(U log U) permutation, prp the O(active) index map.
+    from heterofl_tpu.fed.core import superstep_user_schedule
+
+    def _draw_sec(kind, reps=3):
+        superstep_user_schedule(base_key, 1, 1, users, n_active,
+                                sampler=kind)  # warm the dispatch caches
+        best = float("inf")
+        for i in range(reps):
+            t0 = time.time()
+            superstep_user_schedule(base_key, 2 + i, 1, users, n_active,
+                                    sampler=kind)
+            best = min(best, time.time() - t0)
+        return best
+
+    hb(f"sampler microbench (kind {sampler_kind}, {users} users)")
+    _draw = {k: _draw_sec(k) for k in ("prp", "perm")}
+    sampler_extra = {
+        "kind": sampler_kind,
+        "users": users,
+        "num_active": n_active,
+        "draw_sec": {k: round(v, 6) for k, v in _draw.items()},
+        "speedup_prp_vs_perm": round(_draw["perm"] / max(_draw["prp"], 1e-9),
+                                     2),
+        "source": "fed.core.superstep_user_schedule([1, A] draw, best of 3)",
+    }
+    hb(f"sampler draw: prp {_draw['prp']:.4f}s perm {_draw['perm']:.4f}s "
+       f"({sampler_extra['speedup_prp_vs_perm']}x)")
+
     # population mode (ISSUE 6): per-engine prefetched cohorts -- dispatch
     # i+1's cohort stages while dispatch i's scanned program computes
     _pop_cohorts = {}
 
     def stage_pop(eng, strat, epoch0, k_disp, tmr):
-        from heterofl_tpu.fed.core import (superstep_rate_schedule,
-                                           superstep_user_schedule)
+        from heterofl_tpu.fed.core import superstep_rate_schedule
 
-        us = superstep_user_schedule(base_key, epoch0, k_disp, users,
-                                     n_active, schedule=sched_spec)
+        with tmr.phase("sample"):
+            us = superstep_user_schedule(base_key, epoch0, k_disp, users,
+                                         n_active, schedule=sched_spec,
+                                         sampler=sampler_kind)
         track_participation(us)
         if strat == "grouped":
             rates = superstep_rate_schedule(base_key, epoch0, k_disp, cfg, us)
@@ -759,10 +866,11 @@ def main():
                 if not any(mask):
                     mask = None
             if strat == "grouped":
-                from heterofl_tpu.fed.core import superstep_user_schedule
-
-                us = superstep_user_schedule(base_key, epoch0, k_disp, users,
-                                             n_active, schedule=sched_spec)
+                with tmr.phase("sample"):
+                    us = superstep_user_schedule(base_key, epoch0, k_disp,
+                                                 users, n_active,
+                                                 schedule=sched_spec,
+                                                 sampler=sampler_kind)
                 track_participation(us)
                 params, pending = eng.train_superstep(
                     params, base_key, epoch0, k_disp, us, rates_vec[us], data,
@@ -774,11 +882,11 @@ def main():
                     # scenario runs take the host-drawn schedule (same
                     # stream as the in-jit draw) so participation is
                     # countable per round
-                    from heterofl_tpu.fed.core import superstep_user_schedule
-
-                    us = superstep_user_schedule(base_key, epoch0, k_disp,
-                                                 users, n_active,
-                                                 schedule=sched_spec)
+                    with tmr.phase("sample"):
+                        us = superstep_user_schedule(base_key, epoch0,
+                                                     k_disp, users, n_active,
+                                                     schedule=sched_spec,
+                                                     sampler=sampler_kind)
                     track_participation(us)
                 params, pending = eng.train_superstep(
                     params, base_key, epoch0, k_disp, data, user_schedule=us,
@@ -787,12 +895,20 @@ def main():
         else:
             if sched_spec is not None:
                 epoch = 1 + i
-                user_idx = np.asarray(round_users(
-                    jax.random.fold_in(base_key, epoch), users, n_active,
-                    avail=sched_spec.avail_row(epoch)))
+                with tmr.phase("sample"):
+                    user_idx = np.asarray(round_users(
+                        jax.random.fold_in(base_key, epoch), users, n_active,
+                        avail=sched_spec.avail_row(epoch),
+                        sampler=sampler_kind))
                 track_participation(user_idx[None])
-            else:
+            elif sampler_kind == "perm":
+                # the drivers' legacy numpy K=1 stream (reference parity)
                 user_idx = rng_.permutation(users)[:n_active].astype(np.int32)
+            else:
+                with tmr.phase("sample"):
+                    user_idx = np.asarray(round_users(
+                        jax.random.fold_in(base_key, 1 + i), users, n_active,
+                        sampler=sampler_kind))
             if strat == "grouped":
                 params, pending = eng.train_round(
                     params, user_idx, rates_vec[user_idx], data, 0.1,
@@ -977,6 +1093,7 @@ def main():
                       "active_clients": n_active, "users": users,
                       "n_train": n_train, "final_loss": round(loss, 4),
                       "strategy": strategy,
+                      "sampler": sampler_extra,
                       "mfu": mfu_extra(rps),
                       "wire": wire_extra,
                       "compile_cache": {

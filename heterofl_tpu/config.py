@@ -223,6 +223,30 @@ DEFAULT_CFG: Dict[str, Any] = {
     # ones; buffered cannot combine with a lossy wire_codec (both add a
     # scan carry) and scenario schedules need a mesh-native strategy.
     "schedule": None,
+    # population sampler (ISSUE 11, heterofl_tpu/fed/sampling.py): how the
+    # per-round active cohort is drawn from THE one sampling stream
+    # (fed.core.round_users).  "prp" (default) draws round r's cohort as
+    # the image of [0, num_active) under a keyed pseudorandom-permutation
+    # index map (variable-round Feistel + cycle-walking, exact bijection
+    # for arbitrary num_users) -- O(active) work, no [num_users] buffer,
+    # traceable in-jit; availability rows filter via an O(active x
+    # overdraw) draw-then-filter walk with bounded spill to -1 padding.
+    # "perm" is the legacy full jax.random.permutation(num_users) draw,
+    # bit-for-bit identical to the pre-ISSUE-11 stream (parity tests, old
+    # trajectory reproduction).  The two are different streams: switching
+    # re-baselines every seeded trajectory, and bench.py refuses to
+    # compare records across them without BENCH_ALLOW_STREAM_CHANGE=1.
+    "sampler": "prp",
+    # schedule commitment (ISSUE 11): None (default) = stateless sampler,
+    # the schedule is a pure function of the key stream and streaming
+    # prefetch is unconstrained.  An int >= 0 turns on commitment:
+    # superstep N+1's cohort is drawn from superstep N-sample_horizon's
+    # FETCHED state (fed.sampling.ScheduleCommitment gates the prefetch
+    # queue), so an output-dependent sampler keeps the PR 6 staging
+    # overlap (horizon 1) instead of forcing stream_prefetch=False.  For
+    # the stateless perm/prp samplers the committed schedule is
+    # bit-identical to the immediate one (contract-tested).
+    "sample_horizon": None,
     # sampled/rolling eval cohort (ISSUE 9 satellite): with
     # client_store='stream', evaluate the per-user Local metrics on a
     # rolling N-user window instead of the whole population -- local eval
@@ -459,6 +483,12 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
 
     resolve_codec_cfg(cfg)
     resolve_prefetch_depth(cfg)
+    # sampler validation (ISSUE 11): unknown sampler kinds / malformed
+    # sample_horizon fail HERE, never as a silent default-sampler fallback
+    # (fed.sampling is import-light at the top, like sched/ and obs/)
+    from .fed.sampling import resolve_sampler_cfg
+
+    resolve_sampler_cfg(cfg)
     # scheduler validation (ISSUE 9): unknown kinds/keys or a trace whose
     # user axis disagrees with num_users fail HERE, at config time
     resolve_schedule_cfg(cfg)

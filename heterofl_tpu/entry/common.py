@@ -40,6 +40,7 @@ from ..data import (
 )
 from ..fed.core import (round_rates, round_users, superstep_rate_schedule,
                         superstep_user_schedule, validate_width_geometry)
+from ..fed.sampling import ScheduleCommitment, resolve_sampler_cfg
 from ..sched import resolve_schedule_cfg
 from ..models import make_model
 from ..parallel import (ClientStore, MetricsPipeline, PendingMetrics,
@@ -196,6 +197,25 @@ class FedExperiment:
         self.evaluator = Evaluator(self.model, cfg, self.mesh, seed=seed)
         self.scheduler = make_scheduler(cfg)
         self.num_active = int(np.ceil(cfg["frac"] * cfg["num_users"]))
+        if not 0 <= self.num_active <= cfg["num_users"]:
+            # round_users would raise the same on the first draw; failing
+            # at construction names the config knob instead of a mid-run
+            # sampling error (ISSUE 11 satellite)
+            raise ValueError(
+                f"frac={cfg['frac']} draws num_active={self.num_active} "
+                f"outside [0, num_users={cfg['num_users']}]")
+        # population sampler (ISSUE 11, fed/sampling.py): 'prp' = O(active)
+        # index-map draw (default), 'perm' = the legacy full-permutation
+        # stream.  sample_horizon != None turns on schedule commitment:
+        # superstep N+1's cohort draws from superstep N-horizon's FETCHED
+        # state, which keeps the streaming prefetch overlap legal for
+        # output-dependent samplers (stateless samplers are bit-identical
+        # under commitment -- contract-tested).
+        self.sampler_spec = resolve_sampler_cfg(cfg)
+        self._commitment = (ScheduleCommitment(self.sampler_spec.horizon)
+                            if self.sampler_spec.committed else None)
+        self._ss_dispatched = 0  # streaming superstep dispatch counter
+        self._ss_fetched = 0     # ... and its fetched-state twin
         self._round_times: List[float] = []  # steady-state round durations (ETA)
         self._first_round_done = False
         # staging/dispatch telemetry + async metric fetch (parallel/staging.py):
@@ -511,18 +531,22 @@ class FedExperiment:
     # -- one round -----------------------------------------------------
 
     def sample_users(self, epoch: int) -> np.ndarray:
-        """The K=1 host draw.  Uniform keeps the drivers' legacy numpy
-        permutation stream (reference parity, bit-identical trajectories);
-        availability schedules draw through THE shared sampling stream
-        (:func:`~..fed.core.round_users` at the round key) so the K=1 and
-        superstep paths replay the same trace -- unavailable slots come
-        back -1 and flow through the engines as padding."""
-        if self.sched_spec.kind == "uniform":
+        """The K=1 host draw.  Uniform under ``sampler='perm'`` keeps the
+        drivers' legacy numpy permutation stream (reference parity,
+        bit-identical trajectories); everything else -- the 'prp' sampler
+        and every availability schedule -- draws through THE shared
+        sampling stream (:func:`~..fed.core.round_users` at the round key)
+        so the K=1 and superstep paths replay the same trace: unavailable
+        slots come back -1 and flow through the engines as padding."""
+        if self.sched_spec.kind == "uniform" \
+                and self.sampler_spec.kind == "perm":
             return self.rng.permutation(self.cfg["num_users"])[: self.num_active].astype(np.int32)
         key = jax.random.fold_in(self.host_key, epoch)
-        return np.asarray(round_users(key, self.cfg["num_users"],
-                                      self.num_active,
-                                      avail=self.sched_spec.avail_row(epoch)))
+        with self.phase_timer.phase("sample"):
+            return np.asarray(round_users(key, self.cfg["num_users"],
+                                          self.num_active,
+                                          avail=self.sched_spec.avail_row(epoch),
+                                          sampler=self.sampler_spec.kind))
 
     def train_round(self, params, epoch: int, lr: float, logger: Logger):
         user_idx = self.sample_users(epoch)
@@ -587,10 +611,17 @@ class FedExperiment:
         samples in-jit, evaluated on the host where slot packing needs the
         ids (sharded placement, grouped level grouping, cohort staging).
         The availability schedule (ISSUE 9) threads through the shared
-        stream, so host- and in-jit-sampled paths replay the same trace."""
-        return superstep_user_schedule(self.host_key, epoch0, k,
-                                       self.cfg["num_users"], self.num_active,
-                                       schedule=self.sched_spec)
+        stream, so host- and in-jit-sampled paths replay the same trace;
+        the sampler kind (ISSUE 11) threads the same way -- host schedules
+        and the in-jit draw must name the same sampler.  The draw is its
+        own ``sample`` phase (PhaseTimer) so the O(U) -> O(active) win is
+        visible per round instead of hiding inside ``stage``."""
+        with self.phase_timer.phase("sample"):
+            return superstep_user_schedule(self.host_key, epoch0, k,
+                                           self.cfg["num_users"],
+                                           self.num_active,
+                                           schedule=self.sched_spec,
+                                           sampler=self.sampler_spec.kind)
 
     # -- streaming cohort pipeline (ISSUE 6) ---------------------------
 
@@ -614,12 +645,40 @@ class FedExperiment:
         if self._next_cohorts and self._next_cohorts[0][:2] == (epoch0, k):
             return self._next_cohorts.pop(0)[2]
         self._next_cohorts = []  # a schedule jump invalidates the queue
+        if self._commitment is not None \
+                and not self._commitment.may_draw(self._ss_dispatched + 1):
+            # every legal knob combination fetches (and commits) at least
+            # once per superstep push, so the state THIS dispatch's draw
+            # consumes is always on the host by now; reaching here means a
+            # metrics fetch was deferred past the commitment horizon, and
+            # drawing anyway would consume uncommitted state silently --
+            # the exact hole sample_horizon exists to close.  Fail loudly.
+            raise RuntimeError(
+                f"schedule commitment: the superstep at epoch {epoch0} "
+                f"draws from superstep "
+                f"{self._ss_dispatched - self.sampler_spec.horizon}'s "
+                f"state but only {self._ss_fetched} superstep(s) have "
+                f"fetched -- a deferred metrics fetch crossed "
+                f"sample_horizon={self.sampler_spec.horizon}")
+        if self._commitment is not None and self.sampler_spec.horizon == 0 \
+                and self._ss_dispatched > 0 and self.stream_prefetch \
+                and not self._stream_sync_warned:
+            self._stream_sync_warned = True
+            warnings.warn(
+                "sample_horizon=0 (strictly output-dependent sampler) is "
+                "staging SYNCHRONOUSLY: each cohort draws from the "
+                "previous superstep's just-fetched state, so staging "
+                "cannot overlap compute -- sample_horizon=1 commits one "
+                "state further back and keeps the overlap")
         if not self.stream_prefetch and not self._stream_sync_warned:
             self._stream_sync_warned = True
             warnings.warn(
                 "client_store='stream' is staging SYNCHRONOUSLY "
                 "(stream_prefetch=False): cohort materialisation serialises "
-                "with the round compute instead of overlapping it")
+                "with the round compute instead of overlapping it -- an "
+                "output-dependent sampler can keep the overlap by "
+                "committing its schedule instead (cfg['sample_horizon'], "
+                "ISSUE 11)")
         return self._stage_cohort(epoch0, k)
 
     def _prefetch_cohort(self, epoch0: int):
@@ -636,6 +695,14 @@ class FedExperiment:
              if self._next_cohorts else epoch0)
         while len(self._next_cohorts) < self._prefetch_depth \
                 and e <= n_rounds:
+            if self._commitment is not None and not self._commitment.may_draw(
+                    self._ss_dispatched + len(self._next_cohorts) + 1):
+                # schedule commitment (ISSUE 11): this superstep's cohort
+                # would consume state not yet fetched -- stop here; the
+                # queue refills after the next fetch commits it.  At the
+                # sync default (fetch_every=1) horizon 1 always admits the
+                # next superstep, so the PR 6 overlap survives.
+                break
             k = min(self.superstep_rounds, n_rounds - e + 1)
             self._next_cohorts.append((e, k, self._stage_cohort(e, k)))
             e += k
@@ -756,6 +823,7 @@ class FedExperiment:
                 params, self.host_key, epoch0, k, timer=self.phase_timer,
                 eval_mask=mask if fused else None, fused_eval=fused,
                 lr=lr_const, cohort=cohort)
+            self._ss_dispatched += 1
             with self._trace_span("prefetch", {"epoch0": int(epoch0 + k)}):
                 self._prefetch_cohort(epoch0 + k)
         elif cfg.get("strategy") == "grouped":
@@ -826,6 +894,13 @@ class FedExperiment:
         """Log one (possibly deferred) superstep's rounds: train metrics per
         round, with each fused eval's Local/Global metrics logged right
         after the round it evaluated -- the K=1 host-loop ordering."""
+        if self._commitment is not None:
+            # schedule commitment (ISSUE 11): this superstep's state is on
+            # the host NOW -- cohorts that draw from it become stageable.
+            # Fetch order == dispatch order (the metrics pipeline is FIFO),
+            # so the counter pair stays consistent.
+            self._ss_fetched += 1
+            self._commitment.commit(self._ss_fetched, state=out)
         rounds = out["train"] if isinstance(out, dict) else out
         evals = {e["epoch"]: e for e in (out.get("eval") or [])} \
             if isinstance(out, dict) else {}

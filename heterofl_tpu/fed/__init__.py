@@ -1,10 +1,33 @@
-from .core import (  # noqa: F401
-    active_indices,
-    combine_counted,
-    embed_sliced,
-    extract_sliced,
-    sample_model_rates,
-    to_width_rates,
-    client_count_masks,
-    distribute_masked,
+"""Federation package: sub-model extraction, counted-average aggregation
+(:mod:`.core`), the population sampler subsystem (:mod:`.sampling`, ISSUE
+11) and the host-orchestrated sliced debug twin (:mod:`.sliced`).
+
+The package ``__init__`` is LAZY (PEP 562): :mod:`.core` imports jax, but
+:mod:`.sampling`'s config half must stay importable jax-free --
+``config.process_control`` validates ``cfg['sampler']`` /
+``cfg['sample_horizon']`` through it, and the config module's jax-free
+import contract (offline analysis tooling) would otherwise silently
+break.  ``from heterofl_tpu.fed import extract_sliced`` still works; it
+just resolves :mod:`.core` on first touch.
+"""
+
+_CORE_EXPORTS = (
+    "active_indices",
+    "combine_counted",
+    "embed_sliced",
+    "extract_sliced",
+    "sample_model_rates",
+    "to_width_rates",
+    "client_count_masks",
+    "distribute_masked",
 )
+
+__all__ = list(_CORE_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _CORE_EXPORTS:
+        from . import core
+
+        return getattr(core, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

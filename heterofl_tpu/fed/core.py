@@ -98,7 +98,7 @@ def round_rates(round_key: jax.Array, cfg: Dict[str, Any],
 
 
 def round_users(round_key: jax.Array, num_users: int, num_active: int,
-                avail=None) -> jnp.ndarray:
+                avail=None, sampler: str = "prp") -> jnp.ndarray:
     """The per-round active-client draw, salt included: THE one definition
     of the superstep sampling stream (the jax twin of the drivers'
     ``rng.permutation(num_users)[:num_active]``).  Consumed in-jit by the
@@ -108,17 +108,41 @@ def round_users(round_key: jax.Array, num_users: int, num_active: int,
     becomes a PRNG artifact.  Traceable (``round_key`` may be a traced
     key).
 
+    ``sampler`` (ISSUE 11, :mod:`.sampling`): ``'prp'`` (default) draws
+    the cohort as the image of ``[0, num_active)`` under a keyed
+    pseudorandom-permutation index map -- O(num_active) work, no ``[U]``
+    buffer; ``'perm'`` is the legacy full ``permutation(num_users)`` draw,
+    preserved bit for bit for parity tests and old trajectories.  The two
+    are DIFFERENT streams: switching re-baselines every seeded trajectory
+    (deliberately; the bench refuses cross-stream comparisons).
+
     ``avail`` (ISSUE 9, :mod:`~..sched`): this round's ``[num_users]`` 0/1
-    availability row.  ``None`` (uniform) keeps today's draw bit for bit.
-    With a row, available users are drawn FIRST in permutation order and
-    slots the availability cannot fill come back as ``-1`` -- the engines'
-    padding-slot convention, so a thin round degrades to partial
-    participation instead of resampling unavailable users.  An all-ones
-    row selects exactly the uniform cohort (the stable sort preserves
-    permutation order), which is what makes trace replay a strict
-    generalisation of the uniform stream."""
-    perm = jax.random.permutation(
-        jax.random.fold_in(round_key, USER_SAMPLE_SALT), num_users)
+    availability row.  ``None`` (uniform) keeps the sampler's plain draw
+    bit for bit.  With a row, available users are drawn FIRST in
+    permutation order and slots the availability cannot fill come back as
+    ``-1`` -- the engines' padding-slot convention, so a thin round
+    degrades to partial participation instead of resampling unavailable
+    users.  Under ``perm`` the filter is the legacy ``[U]`` gather +
+    stable argsort; under ``prp`` it is an O(num_active x overdraw)
+    draw-then-filter walk along the PRP with bounded spill
+    (:func:`~.sampling.prp_round_users`).  Either way an all-ones row
+    selects exactly that sampler's uniform cohort, which is what makes
+    trace replay a strict generalisation of the uniform stream."""
+    if not 0 <= num_active <= num_users:
+        raise ValueError(
+            f"round_users: num_active={num_active} must be in [0, "
+            f"num_users={num_users}] -- the legacy permutation draw would "
+            f"silently short the cohort (and a negative count silently "
+            f"wrap); fix cfg['frac']/num_active")
+    if sampler not in ("perm", "prp"):
+        raise ValueError(f"Not valid sampler: {sampler!r} (one of "
+                         f"('perm', 'prp'))")
+    skey = jax.random.fold_in(round_key, USER_SAMPLE_SALT)
+    if sampler == "prp":
+        from .sampling import prp_round_users
+
+        return prp_round_users(skey, num_users, num_active, avail=avail)
+    perm = jax.random.permutation(skey, num_users)
     if avail is None:
         return perm[:num_active].astype(jnp.int32)
     a = jnp.asarray(avail, jnp.float32)[perm]
@@ -130,7 +154,7 @@ def round_users(round_key: jax.Array, num_users: int, num_active: int,
 
 def superstep_user_schedule(host_key: jax.Array, epoch0: int, k: int,
                             num_users: int, num_active: int,
-                            schedule=None) -> np.ndarray:
+                            schedule=None, sampler: str = "prp") -> np.ndarray:
     """Host-side ``[k, A]`` active-user draw from THE superstep sampling
     stream (:func:`round_users` at per-round keys ``fold_in(host_key,
     epoch0 + r)``): the one host twin of the masked engine's in-jit draw.
@@ -141,12 +165,23 @@ def superstep_user_schedule(host_key: jax.Array, epoch0: int, k: int,
     ``schedule`` (ISSUE 9): a :class:`~..sched.ScheduleSpec`; its per-round
     availability rows thread into :func:`round_users` (``None`` or the
     uniform kind leaves the stream untouched).  ``-1`` entries mark slots
-    the availability could not fill -- padding slots to every consumer."""
+    the availability could not fill -- padding slots to every consumer.
+    ``sampler`` (ISSUE 11) threads straight through -- the host schedule
+    and the in-jit draw must name the same sampler or the stream forks."""
+    if epoch0 < 0:
+        raise ValueError(f"superstep_user_schedule: epoch0={epoch0} must "
+                         f"be non-negative (per-round keys are fold_in("
+                         f"host_key, epoch0 + r); a negative epoch silently "
+                         f"replays another round's stream)")
+    if k < 0:
+        raise ValueError(f"superstep_user_schedule: k={k} must be "
+                         f"non-negative")
     return np.stack([
         np.asarray(round_users(
             jax.random.fold_in(host_key, epoch0 + r), num_users, num_active,
-            avail=None if schedule is None else schedule.avail_row(epoch0 + r)))
-        for r in range(k)])
+            avail=None if schedule is None else schedule.avail_row(epoch0 + r),
+            sampler=sampler))
+        for r in range(k)]) if k else np.zeros((0, num_active), np.int32)
 
 
 def superstep_rate_schedule(host_key: jax.Array, epoch0: int, k: int,

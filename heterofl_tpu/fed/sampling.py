@@ -1,0 +1,289 @@
+"""Population sampler (ISSUE 11 tentpole): O(active) cohort draws.
+
+HeteroFL's client draw is a full-population shuffle
+(``rng.permutation(num_users)[:num_active]``, ref fed.py) and our jax twin
+kept that shape: :func:`~.core.round_users` materialised
+``jax.random.permutation(num_users)`` every round -- O(U log U) work and a
+``[U]`` buffer per round, ~0.8 s/round at 1e6 users, which dominates once
+the TPU round itself shrinks (ROADMAP "population-scale sampling").  This
+module makes the draw a *subsystem* behind the existing one-stream
+contract:
+
+* **``sampler='prp'`` (default)** -- a keyed pseudorandom-permutation
+  index map: a variable-round balanced Feistel network over the smallest
+  even-bit binary domain covering ``[0, num_users)``, made an EXACT
+  bijection on ``[0, num_users)`` for arbitrary (non-power-of-two) U by
+  cycle-walking.  Round r's cohort is ``prp(fold_in(key, r))([0..A))`` --
+  O(A) work, O(A) memory, traceable in-jit (the engines draw it inside the
+  fused K-round scan), and never builds a ``[U]`` buffer.
+* **``sampler='perm'``** -- the legacy full-permutation draw, preserved
+  bit for bit for parity tests and old-trajectory reproduction.
+
+Availability (ISSUE 9) composes without the full-row sort: instead of
+gathering ``avail[perm]`` and stable-argsorting a ``[U]`` row, the PRP
+path walks ``overdraw x A`` candidates along the permutation, keeps the
+available ones in PRP order, and spills unfillable slots to ``-1``
+(partial participation) -- O(A x overdraw) gathers.  An all-ones row
+selects exactly the uniform-PRP cohort, so trace replay stays a strict
+generalisation of the uniform stream.
+
+Schedule commitment (``sample_horizon``): an OUTPUT-dependent sampler
+(loss/staleness-prioritized cohorts, the ROADMAP follow-ons) cannot draw
+superstep N+1 while N is still in flight -- which is why PR 6's streaming
+driver had to offer the synchronous ``stream_prefetch=False`` fallback.
+``sample_horizon=1`` commits the draw one state behind instead: superstep
+N+1's cohort is drawn from superstep N-1's fetched state
+(:class:`ScheduleCommitment` gates the prefetch queue), so the staging
+overlap survives.  For the stateless perm/prp samplers the committed
+schedule is identical to the immediate one -- bit-for-bit, which is the
+contract tests pin.
+
+This module is import-light at the top (numpy only), like ``sched/`` and
+``obs/``: config validation stays jax-free for ``config.process_control``;
+the jax halves import jax lazily inside the traced functions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+#: the sampler registry (``cfg['sampler']``)
+SAMPLER_KINDS = ("perm", "prp")
+
+#: availability overdraw: how many PRP candidates the draw-then-filter walk
+#: visits per cohort slot before spilling the remainder to -1 (padding).
+#: At overdraw b, a round whose availability rate is p fills every slot
+#: with probability ~1 - exp(-A(bp - 1)^2 / 2b) -- 4 covers p >= 0.5
+#: essentially always, and thinner rounds *should* degrade to partial
+#: participation (the ISSUE 9 semantics) rather than scan the whole row.
+AVAIL_OVERDRAW = 4
+
+#: PRP round-key derivation salt (folded into the per-round sample key so
+#: the Feistel key schedule is independent of any other use of the key)
+PRP_KEY_SALT = 23
+
+
+# ---------------------------------------------------------------------------
+# config half (jax-free)
+# ---------------------------------------------------------------------------
+
+class SamplerSpec:
+    """The resolved sampler configuration: one immutable object the driver,
+    engines, staticcheck and bench all consume (built by
+    :func:`resolve_sampler_cfg` -- there is no second parser).
+
+    ``kind``: ``'prp'`` (O(active) index-map draw, the default) or
+    ``'perm'`` (the legacy full-permutation stream, bit-for-bit).
+    ``horizon``: ``None`` for stateless samplers (the schedule is a pure
+    function of the key stream; prefetch is unconstrained) or an int >= 0
+    -- the schedule-commitment mode, where superstep N+1's cohort may only
+    consume state fetched through superstep ``N - horizon``."""
+
+    def __init__(self, kind: str = "prp", horizon: Optional[int] = None):
+        self.kind = kind
+        self.horizon = horizon
+
+    @property
+    def committed(self) -> bool:
+        return self.horizon is not None
+
+
+def resolve_sampler_cfg(cfg: Dict[str, Any]) -> SamplerSpec:
+    """Validate ``cfg['sampler']`` / ``cfg['sample_horizon']`` and return
+    the :class:`SamplerSpec`.  THE one validator (the PR 6/8 convention:
+    unknown values fail loudly at config time, never as a silent
+    default-sampler fallback mid-run)."""
+    kind = cfg.get("sampler", "prp") or "prp"
+    if kind not in SAMPLER_KINDS:
+        raise ValueError(f"Not valid sampler: {kind!r} (one of "
+                         f"{SAMPLER_KINDS}; 'prp' is the O(active) "
+                         f"index-map draw, 'perm' the legacy full "
+                         f"permutation)")
+    horizon = cfg.get("sample_horizon")
+    if horizon is not None:
+        if not isinstance(horizon, int) or isinstance(horizon, bool) \
+                or horizon < 0:
+            raise ValueError(f"Not valid sample_horizon: {horizon!r} (an "
+                             f"int >= 0 -- superstep N+1's cohort draws "
+                             f"from superstep N-horizon's committed state "
+                             f"-- or None for a stateless sampler)")
+    return SamplerSpec(kind=kind, horizon=horizon)
+
+
+class ScheduleCommitment:
+    """The schedule-commitment ledger (``sample_horizon``): which superstep
+    states have been fetched, and therefore which future cohorts may be
+    drawn.  Superstep indices count dispatches (1-based); superstep ``n``'s
+    cohort may consume state no fresher than superstep ``n - horizon - 1``,
+    so :meth:`may_draw` answers "is everything that draw would read already
+    on the host?".
+
+    With the driver's dispatch -> prefetch -> fetch ordering and
+    ``horizon=1``, prefetching superstep N+1 while N is in flight is
+    allowed exactly because its draw reads superstep N-1's state -- the
+    PR 6 staging overlap survives output-dependent samplers.  ``state`` is
+    the opaque committed payload a state-consuming sampler would read
+    (:meth:`state_for`); the stateless perm/prp samplers ignore it, which
+    is why their committed schedule is bit-identical to the immediate
+    one."""
+
+    def __init__(self, horizon: int):
+        self.horizon = int(horizon)
+        self._committed = 0  # highest superstep index whose state is fetched
+        self._states: Dict[int, Any] = {}
+
+    @property
+    def committed_through(self) -> int:
+        return self._committed
+
+    def commit(self, index: int, state: Any = None) -> None:
+        """Record superstep ``index``'s fetched state (monotonic)."""
+        index = int(index)
+        if index > self._committed:
+            self._committed = index
+        self._states[index] = state
+        # the ledger only ever needs states a draw can still reference
+        floor = self._committed - (self.horizon + 1)
+        for k in [k for k in self._states if k < floor]:
+            del self._states[k]
+
+    def may_draw(self, index: int) -> bool:
+        """May superstep ``index``'s cohort be drawn now?  True iff the
+        state it consumes (superstep ``index - horizon - 1``; <= 0 means
+        the initial state) is committed."""
+        return int(index) - (self.horizon + 1) <= self._committed
+
+    def state_for(self, index: int) -> Any:
+        """The committed state superstep ``index``'s draw consumes (None
+        before any commit / for pre-run indices)."""
+        return self._states.get(int(index) - (self.horizon + 1))
+
+
+# ---------------------------------------------------------------------------
+# jax half: the PRP index map (traced; jax imported lazily so the module
+# top stays import-light for config.process_control)
+# ---------------------------------------------------------------------------
+
+def _feistel_geometry(num_users: int):
+    """Static Feistel geometry for a domain covering ``[0, num_users)``:
+    half-width ``b`` (the balanced domain is ``4**b >= num_users``, always
+    < 4x num_users) and the variable round count -- small domains mix
+    poorly per round, so they get more rounds (the cost is O(A) either
+    way)."""
+    b = 1
+    while (1 << (2 * b)) < num_users:
+        b += 1
+    rounds = 24 if b <= 4 else (16 if b <= 8 else 10)
+    return b, rounds
+
+
+def _mix32(v, k):
+    """murmur3-style 32-bit finalizer of ``v`` keyed by ``k`` -- the
+    Feistel round function (uint32 lattice, wraps naturally)."""
+    import jax.numpy as jnp
+
+    h = v ^ k
+    h = (h ^ (h >> jnp.uint32(16))) * jnp.uint32(0x85EBCA6B)
+    h = (h ^ (h >> jnp.uint32(13))) * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> jnp.uint32(16))
+
+
+def prp_map(key, x, num_users: int):
+    """Apply the keyed PRP over ``[0, num_users)`` to ``x`` (int array of
+    in-range indices): an exact bijection for ARBITRARY num_users, built
+    from a balanced Feistel network on the covering binary domain plus
+    cycle-walking (re-encrypt until the image lands back in range;
+    starting in range guarantees termination because the walk follows the
+    permutation's own cycle).  O(len(x)) work and memory -- independent of
+    ``num_users`` -- and traceable (``key`` and ``x`` may be traced; the
+    walk is a ``lax.while_loop``)."""
+    import jax
+    import jax.numpy as jnp
+
+    if num_users < 1:
+        raise ValueError(f"prp_map needs num_users >= 1, got {num_users}")
+    x = jnp.asarray(x)
+    if num_users == 1:
+        return jnp.zeros(x.shape, jnp.int32)
+    b, rounds = _feistel_geometry(num_users)
+    mask = jnp.uint32((1 << b) - 1)
+    rk = jax.random.bits(jax.random.fold_in(key, PRP_KEY_SALT),
+                         (rounds,), jnp.uint32)
+    u = jnp.uint32(num_users)
+
+    def enc(v):
+        lo = v & mask
+        hi = v >> jnp.uint32(b)
+        for r in range(rounds):
+            hi, lo = lo, hi ^ (_mix32(lo, rk[r]) & mask)
+        return (hi << jnp.uint32(b)) | lo
+
+    y = enc(x.astype(jnp.uint32))
+    y = jax.lax.while_loop(
+        lambda v: jnp.any(v >= u),
+        lambda v: jnp.where(v >= u, enc(v), v),
+        y)
+    return y.astype(jnp.int32)
+
+
+#: host-path compiled draws, keyed by the static draw geometry.  The PRP
+#: is ~30 tiny integer ops plus the cycle walk; dispatched eagerly they
+#: cost ~1e5x the compute (per-op host dispatch), so the HOST draw runs
+#: through one cached jit per (U, A, overdraw, has-avail) shape while
+#: traced callers (the engines' in-jit draw) inline the plain ops --
+#: integer lattice both ways, so jit == eager bitwise by construction.
+_HOST_DRAWS: Dict[tuple, Any] = {}
+
+
+def prp_round_users(sample_key, num_users: int, num_active: int,
+                    avail=None, overdraw: int = AVAIL_OVERDRAW):
+    """One round's cohort under the PRP sampler: the image of ``[0,
+    num_active)`` under the keyed bijection -- O(num_active), no ``[U]``
+    buffer (``sample_key`` is the already-salted per-round sample key;
+    :func:`~.core.round_users` owns the salt).
+
+    ``avail``: this round's ``[num_users]`` 0/1 availability row.  The
+    draw-then-filter walk visits ``min(num_users, overdraw * num_active)``
+    PRP candidates in permutation order, keeps the available ones, and
+    spills slots the walk could not fill to ``-1`` -- the engines' padding
+    convention, so a thin round degrades to partial participation exactly
+    like the legacy sort path (bounded spill: availability below
+    ~1/overdraw trades full cohorts for O(A) cost, by design).  An
+    all-ones row selects exactly the uniform-PRP cohort (the first
+    ``num_active`` candidates ARE that cohort)."""
+    import jax
+
+    if not isinstance(sample_key, jax.core.Tracer) \
+            and not isinstance(avail, jax.core.Tracer):
+        ck = (num_users, num_active, overdraw, avail is None)
+        fn = _HOST_DRAWS.get(ck)
+        if fn is None:
+            def fn(k, av=None, _ck=ck):
+                return _prp_round_users(k, _ck[0], _ck[1], av, _ck[2])
+
+            fn = jax.jit(fn)
+            _HOST_DRAWS[ck] = fn
+        return fn(sample_key) if avail is None else fn(sample_key, avail)
+    return _prp_round_users(sample_key, num_users, num_active, avail,
+                            overdraw)
+
+
+def _prp_round_users(sample_key, num_users: int, num_active: int,
+                     avail, overdraw: int):
+    import jax.numpy as jnp
+
+    if avail is None:
+        return prp_map(sample_key, jnp.arange(num_active, dtype=jnp.int32),
+                       num_users)
+    budget = min(num_users, max(1, overdraw) * num_active)
+    cand = prp_map(sample_key, jnp.arange(budget, dtype=jnp.int32),
+                   num_users)
+    ok = jnp.asarray(avail, jnp.float32)[cand] > 0
+    rank = jnp.cumsum(ok.astype(jnp.int32)) - 1
+    keep = ok & (rank < num_active)
+    # scatter kept candidates to their fill rank; unfilled slots stay -1
+    # (mode='drop' discards the not-kept lanes routed to index num_active)
+    return jnp.full((num_active,), -1, jnp.int32).at[
+        jnp.where(keep, rank, num_active)].set(cand, mode="drop")

@@ -66,6 +66,7 @@ from ..compress import make_codec, resid_slots, resolve_codec_cfg
 from ..config import resolve_prefetch_depth
 from ..fed.core import (combine_counted, embed_sliced_jnp, extract_sliced_jnp,
                         level_flop_table, snap_to_levels)
+from ..fed.sampling import resolve_sampler_cfg
 from ..models import make_model
 from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
@@ -166,6 +167,12 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         # superstep; availability schedules reach this engine through the
         # host-packed user/rate schedules (superstep_user_schedule)
         self._sched_spec = resolve_schedule_cfg(cfg)
+        # population sampler (ISSUE 11): this engine never draws in-jit
+        # (level grouping needs the ids host-side, so cohorts arrive as
+        # host-packed schedules drawn from THE one stream), but the kind is
+        # resolved here so a typo'd sampler fails at construction and the
+        # engine's stream identity is inspectable like the masked one's
+        self._sampler = resolve_sampler_cfg(cfg).kind
         self._sched_buf = None
         if self._sched_spec.buffered and self._codec_name != "dense":
             raise ValueError(

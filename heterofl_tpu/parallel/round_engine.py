@@ -41,6 +41,7 @@ from ..obs import resolve_telemetry_cfg, split_probes
 from ..obs.probes import round_probes
 from ..data.datasets import DATASET_STATS
 from ..fed.core import combine_counted, round_rates, round_users
+from ..fed.sampling import resolve_sampler_cfg
 from ..sched import resolve_schedule_cfg
 from ..sched.buffer import _SchedBufCarry, buffered_combine
 from ..sched.deadline import deadline_steps
@@ -354,6 +355,12 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         # deadline stragglers + buffered-async aggregation.  The lockstep
         # default builds byte-identical programs (zero new carry args).
         self._sched_spec = resolve_schedule_cfg(cfg)
+        # population sampler (ISSUE 11, heterofl_tpu/fed/sampling.py): the
+        # in-jit cohort draw's kind -- 'prp' (O(active) index map, default)
+        # or 'perm' (legacy full permutation).  Resolved at construction so
+        # a typo'd sampler fails here, and captured by _build_superstep so
+        # the compiled draw matches the host schedule stream.
+        self._sampler = resolve_sampler_cfg(cfg).kind
         self._sched_buf = None  # device [2, total] staleness carry
         # runtime telemetry (ISSUE 10, heterofl_tpu/obs/): telemetry='on'
         # folds the in-program health probes into the metrics pytree of
@@ -930,6 +937,7 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         slots_total = per_dev * n_dev
         num_users = self.cfg["num_users"]
         lr_fn = self._lr_fn
+        sampler = self._sampler  # the in-jit draw's kind (ISSUE 11)
         if streaming:
             n_stream = 2 if self.is_lm else 4
             n_fix = 1 if self.fix_rates is not None else 0
@@ -1020,9 +1028,10 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                         row = jnp.take(trace, (t - 1) % trace.shape[0],
                                        axis=0)
                         active = round_users(key, num_users, num_active,
-                                             avail=row)
+                                             avail=row, sampler=sampler)
                     else:
-                        active = round_users(key, num_users, num_active)
+                        active = round_users(key, num_users, num_active,
+                                             sampler=sampler)
                     pad = jnp.full((slots_total - num_active,), -1, jnp.int32)
                     padded = jnp.concatenate([active, pad])
                     d = jax.lax.axis_index("clients")

@@ -245,7 +245,10 @@ class PhaseTimer:
 
     Phases are free-form names; the engines use ``stage`` (host packing +
     placement-cache lookups), ``dispatch`` (program calls returning) and
-    ``fetch`` (D2H metric assembly); bench.py adds ``compute``
+    ``fetch`` (D2H metric assembly); the driver and bench.py add
+    ``sample`` (the host cohort draw, ISSUE 11 -- its own phase so the
+    O(population) -> O(active) sampler win is visible per round instead of
+    hiding inside ``stage``) and bench.py ``compute``
     (block_until_ready).  Cheap enough to leave always on.
 
     ``trace`` (ISSUE 10): attach an :class:`~..obs.trace.TraceRecorder`

@@ -43,7 +43,14 @@ statically enforces:
 (j) **telemetry** (ISSUE 10, :mod:`..obs`) -- the ``telemetry='on'``
     program variants carry the in-program health probes at ZERO wire cost:
     same single global psum, same wire bytes by equality, full donation,
-    and the k1 step body inside the unchanged kernel budget.
+    and the k1 step body inside the unchanged kernel budget;
+(k) **sampler** (ISSUE 11, :mod:`..fed.sampling`) -- both sampler kinds'
+    in-jit draws audited as programs (the legacy ``perm`` superstep stays
+    a pinned variant next to the default ``prp`` one, same psum/wire/
+    donation/HBM budgets), plus the stream-consistency check
+    (:func:`sampler_stream_check`: in-jit == host bitwise, all-ones
+    availability == uniform cohort, exact PRP bijection) and sampler
+    entries in the recompile-hazard matrix.
 
 Widths: the default audit config keeps the flagship *structure* (5-level
 a1-e1 fix mix, both engines, both placements, K in {1, 8}) at test-scale
@@ -290,6 +297,19 @@ def _masked_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
     targets.append((
         "masked/replicated/k8",
         eng._build_superstep(k, _ceil_div(a, n_dev), True, num_active=a),
+        (params, key, np.int32(1)) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(_ceil_div(a, n_dev))}))
+    # sampler variants (ISSUE 11): the default engine above draws its
+    # cohort in-jit from the PRP index map (cfg default sampler='prp'); the
+    # legacy full-permutation stream stays an audited program too -- same
+    # psum/wire/donation/HBM budgets, because the draw is round-level
+    # integer work that must never touch a collective or the step body
+    eng_perm = RoundEngine(model, dict(cfg, sampler="perm"), mesh)
+    eng_perm._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "masked/replicated/k8-perm",
+        eng_perm._build_superstep(k, _ceil_div(a, n_dev), True, num_active=a),
         (params, key, np.int32(1)) + data,
         {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
          "mem": mem(_ceil_div(a, n_dev))}))
@@ -1055,6 +1075,22 @@ def recompile_hazard_check(setup) -> Dict[str, Any]:
     out["masked_superstep"] = {"after_warm": size1,
                                "after_repeat": eng.program_cache_size()}
 
+    # sampler variants (ISSUE 11): the superstep above draws in-jit from
+    # the default PRP index map; the legacy permutation engine must stay
+    # recompile-free too (the sampler kind is an engine-construction
+    # constant, never a per-dispatch cache key)
+    eng_pm = RoundEngine(model, dict(cfg, sampler="perm"), mesh)
+    ppm = model.init(jax.random.key(0))
+    ppm, pend = eng_pm.train_superstep(ppm, jax.random.key(3), 1, 2, data,
+                                       num_active=4)
+    pend.fetch()
+    size1 = eng_pm.program_cache_size()
+    ppm, pend = eng_pm.train_superstep(ppm, jax.random.key(3), 3, 2, data,
+                                       num_active=4)
+    pend.fetch()
+    out["masked_superstep_perm"] = {"after_warm": size1,
+                                    "after_repeat": eng_pm.program_cache_size()}
+
     # eval-fused superstep (ISSUE 4): a fresh-but-identical eval mask (a NEW
     # tuple of the same booleans) must hit the cached program -- the mask is
     # part of the program key, so a tuple-identity (rather than equality)
@@ -1149,6 +1185,62 @@ def recompile_hazard_check(setup) -> Dict[str, Any]:
     return out
 
 
+def sampler_stream_check(report: AuditReport, setup) -> Dict[str, Any]:
+    """Sampling-stream consistency (ISSUE 11): for BOTH sampler kinds the
+    in-jit draw must equal the host draw bitwise (the one-stream contract
+    behind superstep == sequential), an all-ones availability row must
+    select exactly that sampler's uniform cohort (trace replay stays a
+    strict generalisation of the uniform stream), a uniform cohort must be
+    duplicate-free, and the PRP index map must be an exact bijection on
+    ``[0, num_users)``.  Executes tiny draws, like the recompile check."""
+    import jax
+
+    from ..fed.core import round_users
+    from ..fed.sampling import prp_map
+
+    users = setup["users"]
+    a = max(1, users // 2)
+    key = jax.random.fold_in(setup["key"], 77)
+    sec: Dict[str, Any] = {"ok": True, "num_users": users, "num_active": a,
+                           "kinds": {}}
+    for kind in ("perm", "prp"):
+        host = np.asarray(round_users(key, users, a, sampler=kind))
+        jitd = np.asarray(jax.jit(
+            lambda kk, _kind=kind: round_users(kk, users, a,
+                                               sampler=_kind))(key))
+        ones = np.asarray(round_users(key, users, a,
+                                      avail=np.ones(users, np.uint8),
+                                      sampler=kind))
+        rec = {"in_jit_equals_host": bool((host == jitd).all()),
+               "all_ones_equals_uniform": bool((host == ones).all()),
+               "cohort_distinct": len(set(host.tolist())) == a}
+        sec["kinds"][kind] = rec
+        if not rec["in_jit_equals_host"]:
+            report.fail(sec, "sampler-stream",
+                        f"sampler {kind!r}: in-jit draw differs from the "
+                        f"host draw -- the superstep stream has forked "
+                        f"(host {host.tolist()[:8]} vs jit "
+                        f"{jitd.tolist()[:8]})")
+        if not rec["all_ones_equals_uniform"]:
+            report.fail(sec, "sampler-stream",
+                        f"sampler {kind!r}: an all-ones availability row "
+                        f"selects {ones.tolist()[:8]} instead of the "
+                        f"uniform cohort {host.tolist()[:8]} -- trace "
+                        f"replay is no longer a generalisation of the "
+                        f"uniform stream")
+        if not rec["cohort_distinct"]:
+            report.fail(sec, "sampler-stream",
+                        f"sampler {kind!r}: uniform cohort carries "
+                        f"duplicate ids ({host.tolist()})")
+    image = np.sort(np.asarray(prp_map(key, np.arange(users), users)))
+    sec["prp_bijection"] = bool((image == np.arange(users)).all())
+    if not sec["prp_bijection"]:
+        report.fail(sec, "sampler-bijection",
+                    f"prp_map is not a bijection on [0, {users}): sorted "
+                    f"image {image.tolist()[:12]}...")
+    return sec
+
+
 def flop_budget_check(report: AuditReport, setup,
                       level_prog_names: Dict[float, str],
                       tol: Optional[float] = None) -> Dict[str, Any]:
@@ -1221,6 +1313,7 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
     report.flop_budget = flop_budget_check(report, setup, level_prog_names,
                                            tol=flop_tol)
     report.wire_frontier = codec_frontier_check(report)
+    report.sampler = sampler_stream_check(report, setup)
     if with_recompile_check:
         rc = recompile_hazard_check(setup)
         for which, sizes in list(rc.items()):

@@ -82,6 +82,10 @@ class AuditReport:
     #: audit.codec_frontier_check) -- per-codec payload bytes vs dense,
     #: with the int8 <= 25%-of-dense acceptance line enforced
     wire_frontier: Dict[str, Any] = field(default_factory=dict)
+    #: sampling-stream consistency (ISSUE 11: audit.sampler_stream_check)
+    #: -- in-jit == host draw bitwise for both sampler kinds, all-ones
+    #: availability == uniform cohort, PRP exact bijection
+    sampler: Dict[str, Any] = field(default_factory=dict)
     lint: List[Finding] = field(default_factory=list)
     #: baseline-ratchet diff (ISSUE 7: staticcheck/ratchet.py).  ``checked``
     #: is False unless the CLI ran ``--diff-baseline``; a regressed ratchet
@@ -110,7 +114,8 @@ class AuditReport:
         out = list(self.lint)
         for p in self.programs.values():
             out.extend(p.findings)
-        for sec in (self.flop_budget, self.recompile, self.wire_frontier):
+        for sec in (self.flop_budget, self.recompile, self.wire_frontier,
+                    self.sampler):
             out.extend(Finding(**f) for f in sec.get("findings", []))
         return out
 
@@ -124,6 +129,7 @@ class AuditReport:
             "flop_budget": self.flop_budget,
             "recompile": self.recompile,
             "wire_frontier": self.wire_frontier,
+            "sampler": self.sampler,
             "ratchet": self.ratchet,
             "lint": [asdict(f) for f in self.lint],
         }

@@ -533,7 +533,7 @@ def test_cli_green_exit_and_json_schema(cli, capsys):
     rec = json.loads(capsys.readouterr().out)
     assert sorted(rec) == ["config", "flop_budget", "generated_at", "lint",
                            "ok", "programs", "ratchet", "recompile",
-                           "version", "wire_frontier"]
+                           "sampler", "version", "wire_frontier"]
     prog = rec["programs"]["prog/a"]
     for key in ("wire", "memory", "reshards", "step_body", "psum_clients",
                 "donated", "aliased", "flops", "findings"):
