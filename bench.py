@@ -95,6 +95,19 @@ rounds/sec figure measured through a diverging run is not a telemetry
 overhead.  Needs BENCH_SUPERSTEP>1 for the grouped strategy; ignored in
 population mode (the A/B measures the eager flagship program).
 
+BENCH_LEDGER=1 (ISSUE 12): the population-observatory A/B -- one measure
+with telemetry='hist' (cohort histograms riding the metrics fetch) PLUS a
+host-side ClientLedger folded O(active) per fetch from the recomputed
+schedule rows, against one with both off, recorded into extra.obs.ledger
+(overhead percentage, resident ledger bytes + bytes/user, coverage, the
+last hist record).  ledger.npz and the per-fetch {"tag":"ledger"} summary
+lines land under BENCH_TRACE_DIR (default ./obs_trace) for
+`python -m heterofl_tpu.obs.report`.  Unlike BENCH_TELEMETRY this runs in
+population mode too -- BENCH_POPULATION=1e6 IS the acceptance scale for
+the <= ~32 bytes/user resident bound.  Needs BENCH_SUPERSTEP>1 (the
+schedule re-draw covers superstep dispatches); a fired warn-mode watchdog
+refuses the record.
+
 'value' is like-for-like across strategies: the average per-round seconds
 over timed rounds EXCLUDING rounds that compiled a fresh program shape
 (grouped slot-bucket compiles, superstep shape changes; detected via
@@ -1360,6 +1373,102 @@ def main():
         except Exception as e:
             obs_ab.update({"error": repr(e)})
             print(f"bench: telemetry A/B failed: {e!r}", file=sys.stderr)
+        emit(ctx, timed_rounds, strategies=strategies or None)
+
+    # BENCH_LEDGER=1 (ISSUE 12): the population-observatory A/B -- the ON
+    # arm runs telemetry='hist' (cohort histograms in the fetch) and folds
+    # a host-side ClientLedger O(active) per fetch from the re-drawn
+    # schedule rows (the host twin of the in-jit draw: bit-identical by
+    # the sampler-stream contract); the OFF arm is the plain engine.  A
+    # fired warn-mode watchdog refuses the record, like BENCH_TELEMETRY.
+    # Works in population mode -- 1e6 users IS the bytes/user acceptance
+    # measurement -- but needs BENCH_SUPERSTEP>1 (the schedule re-draw
+    # addresses whole superstep dispatches).
+    if os.environ.get("BENCH_LEDGER") == "1" and superstep <= 1:
+        print("bench: BENCH_LEDGER needs BENCH_SUPERSTEP>1 (the per-fetch "
+              "ledger fold re-draws superstep schedule rows); skipping",
+              file=sys.stderr)
+    elif os.environ.get("BENCH_LEDGER") == "1":
+        try:
+            from heterofl_tpu.obs import resolve_telemetry_cfg
+            from heterofl_tpu.obs.ledger import ClientLedger
+            from heterofl_tpu.obs.watchdog import Watchdog
+
+            trace_dir = os.environ.get("BENCH_TRACE_DIR") \
+                or os.path.join(os.getcwd(), "obs_trace")
+            os.makedirs(trace_dir, exist_ok=True)
+            ledger = ClientLedger(
+                users, sorted({float(r) for r in cfg["model_rate"]},
+                              reverse=True))
+            wd = Watchdog(resolve_telemetry_cfg({"telemetry": "hist"})
+                          .watchdog)
+            led_state = {"round": 0, "hist": None}
+            led_jsonl_path = os.path.join(trace_dir, "ledger.jsonl")
+            led_jsonl = open(led_jsonl_path, "w")
+
+            def led_on_round(r, pending, ctx2):
+                out = pending.fetch()
+                rounds_l, probes = out["train"], out.get("obs") or []
+                ctx2["ms"] = rounds_l[-1]
+                epoch0 = 1 + r * superstep
+                us = superstep_user_schedule(base_key, epoch0, superstep,
+                                             users, n_active,
+                                             schedule=sched_spec,
+                                             sampler=sampler_kind)
+                a = us.shape[1]
+                for j, msr in enumerate(rounds_l):
+                    s = ledger.update(epoch0 + j, us[j],
+                                      np.asarray(msr["rate"])[:a],
+                                      np.asarray(msr["loss_sum"])[:a],
+                                      np.asarray(msr["n"])[:a])
+                    led_jsonl.write(json.dumps({"tag": "ledger", **s}) + "\n")
+                led_jsonl.flush()
+                for j, pr in enumerate(probes):
+                    msr = rounds_l[j]
+                    n_j = float(np.asarray(msr["n"]).sum())
+                    loss_j = (float(np.asarray(msr["loss_sum"]).sum()) / n_j
+                              if n_j > 0 else None)
+                    led_state["round"] += 1
+                    led_state["hist"] = {n: v for n, v in pr.items()
+                                         if n.startswith("hist_")}
+                    wd.check(led_state["round"], probes=pr, loss=loss_j)
+
+            hb("[ledger] observatory on-vs-off A/B")
+            try:
+                led_on, _ = measure(
+                    strategy, make_engine(strategy, {"telemetry": "hist"}),
+                    model.init(jax.random.key(0)), PhaseTimer(),
+                    hb_prefix="[ledger/on] ", on_round=led_on_round)
+            finally:
+                led_jsonl.close()
+            led_off, _ = measure(strategy, make_engine(strategy),
+                                 model.init(jax.random.key(0)), PhaseTimer(),
+                                 hb_prefix="[ledger/off] ")
+            npz_path = ledger.save(os.path.join(trace_dir, "ledger.npz"))
+            if wd.fired:
+                obs_ab["ledger"] = {
+                    "error": "watchdog fired during the ledger measure; "
+                             "refusing to record the on-vs-off A/B",
+                    "watchdog_fired": wd.fired[:8],
+                    "ledger_npz": npz_path}
+            else:
+                obs_ab["ledger"] = {
+                    "on": led_on, "off": led_off,
+                    "overhead_pct": round(
+                        100.0 * (led_on["round_sec_steady_avg"]
+                                 / led_off["round_sec_steady_avg"] - 1.0), 2),
+                    "users": users,
+                    "ledger_bytes": ledger.nbytes,
+                    "bytes_per_user": round(ledger.nbytes / users, 3),
+                    "coverage": round(ledger.seen / users, 6),
+                    "participations": int(ledger.count.sum()),
+                    "hist_last": led_state["hist"],
+                    "watchdog_fired": [],
+                    "ledger_npz": npz_path,
+                    "ledger_jsonl": led_jsonl_path}
+        except Exception as e:
+            obs_ab["ledger"] = {"error": repr(e)}
+            print(f"bench: ledger A/B failed: {e!r}", file=sys.stderr)
         emit(ctx, timed_rounds, strategies=strategies or None)
 
 

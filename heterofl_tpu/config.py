@@ -262,10 +262,25 @@ DEFAULT_CFG: Dict[str, Any] = {
     # counter -- into the fused round programs' metrics pytree, computed
     # in-program from already-reduced values (ZERO new collectives; the
     # staticcheck telemetry variants pin the same one-psum wire budget).
+    # "hist" (ISSUE 12) additionally folds the fixed-bucket COHORT
+    # histograms in (obs/hist.py: per-client loss, deadline step fraction,
+    # level membership, buffered staleness magnitude) -- still zero new
+    # collectives, audited at the same budgets.
     # "off" (default) builds bit-identical programs to the pre-obs engines.
     # Needs a mesh-native strategy; the grouped engine needs the fused
     # superstep (superstep_rounds > 1 or client_store='stream').
     "telemetry": "off",
+    # population observatory ledger (ISSUE 12, obs/ledger.py): "on"
+    # maintains a host-side per-client record -- participation count,
+    # last-seen round, cumulative staleness, loss EMA, level history --
+    # updated O(active) at each metrics fetch from the cohort uid rows of
+    # THE one sampling stream, checkpointed/restored with the run, and
+    # snapshotted to ledger.npz for `python -m heterofl_tpu.obs.report`.
+    # Resident cost ~27 bytes/user (uint8..uint32 arrays); never touches
+    # the compiled programs (telemetry-independent).  Needs a mesh-native
+    # strategy and replicated/streaming placement (the sharded slot
+    # packing drops the uid ordering the fold consumes).
+    "ledger": "off",
     # watchdog knobs (telemetry='on' enables it at warn defaults): a dict
     # {"action": "warn"|"abort"|"off", "spike_factor": 3.0, "window": 8} --
     # non-finite params and loss-spikes-vs-rolling-median trip at fetch
@@ -493,11 +508,12 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
     # user axis disagrees with num_users fail HERE, at config time
     resolve_schedule_cfg(cfg)
     resolve_eval_cohort(cfg)
-    # telemetry validation (ISSUE 10): unknown modes/watchdog knobs fail
-    # here, never as a silent telemetry-off fallback mid-run
-    from .obs import resolve_telemetry_cfg
+    # telemetry/ledger validation (ISSUE 10/12): unknown modes/watchdog
+    # knobs fail here, never as a silent telemetry-off fallback mid-run
+    from .obs import resolve_ledger_cfg, resolve_telemetry_cfg
 
     resolve_telemetry_cfg(cfg)
+    resolve_ledger_cfg(cfg)
     return cfg
 
 

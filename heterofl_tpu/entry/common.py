@@ -26,8 +26,9 @@ import numpy as np
 
 from .. import config as C
 from ..compress import resolve_codec_cfg
-from ..obs import resolve_telemetry_cfg, split_probes
-from ..obs.watchdog import Watchdog
+from ..obs import resolve_ledger_cfg, resolve_telemetry_cfg, split_probes
+from ..obs.ledger import ClientLedger
+from ..obs.watchdog import Watchdog, WatchdogError
 from ..data import (
     bptt_windows,
     stack_windows,
@@ -418,6 +419,28 @@ class FedExperiment:
             if (self.obs_spec.probes and self.obs_spec.watchdog is not None) \
             else None
         self.tracer = None  # obs.trace.TraceRecorder, built in run()
+        # population-observatory ledger (ISSUE 12, obs/ledger.py): a
+        # host-side per-client record updated O(active) at each metrics
+        # fetch -- never a program change, so it composes with every
+        # telemetry mode.  Cross-field conflicts fail loudly here.
+        self.ledger_spec = resolve_ledger_cfg(cfg)
+        self.ledger = None
+        if self.ledger_spec.enabled:
+            if cfg.get("strategy") == "sliced":
+                raise ValueError(
+                    "ledger='on' needs a mesh-native strategy ('masked' or "
+                    "'grouped'): the sliced debug twin replays the "
+                    "reference host loop, whose metrics never ride the "
+                    "fetch path the ledger folds from")
+            if cfg.get("data_placement") == "sharded":
+                raise ValueError(
+                    "ledger='on' needs replicated (or streaming) data "
+                    "placement: the sharded slot packing re-orders metric "
+                    "rows by owning device, dropping the schedule-order "
+                    "uid alignment the O(active) fold consumes")
+            self.ledger = ClientLedger(
+                cfg["num_users"],
+                sorted({float(r) for r in cfg["model_rate"]}, reverse=True))
         self._eval_widx = None  # rolling Local-eval window currently staged
         self._fused = None  # FusedEval, built on first eval-bearing superstep
         self.alt_engine = None
@@ -580,7 +603,11 @@ class FedExperiment:
         if profiling:
             jax.block_until_ready(params)
             jax.profiler.stop_trace()
-        tag = {"epoch": epoch, "lr": lr, "dt": 0.0, "phases": {}}
+        # uids ride the tag (ISSUE 12): the K=1 ledger fold needs the drawn
+        # cohort, and the legacy perm+uniform numpy stream is stateful --
+        # it cannot be re-drawn at fetch time like the superstep streams
+        tag = {"epoch": epoch, "lr": lr, "dt": 0.0, "phases": {},
+               "uids": user_idx}
         with self.phase_timer.phase("fetch"):
             due = self.metrics_pipe.push(tag, pending)
         # dt and the phase breakdown are filled in AFTER the push (the tag is
@@ -602,7 +629,8 @@ class FedExperiment:
             self._first_round_done = True  # exclude the compile round
         for tag0, ms_host in due:
             self._log_train_round(logger, tag0["epoch"], tag0["lr"], tag0["dt"],
-                                  tag0["phases"], ms_host)
+                                  tag0["phases"], ms_host,
+                                  uids=tag0.get("uids"))
         return params
 
     def _superstep_schedule(self, epoch0: int, k: int) -> np.ndarray:
@@ -887,8 +915,77 @@ class FedExperiment:
                                 args={"epoch": int(epoch), "loss": loss,
                                       **probes})
         if self.watchdog is not None:
-            self.watchdog.check(epoch, probes=probes, loss=loss,
-                                emit=logger.emit)
+            def emit_trip(ev):
+                # a watchdog trip is abort evidence: it lands on BOTH the
+                # run log and the trace timeline (ISSUE 12 satellite) --
+                # the last event of an aborted run is the watchdog instant
+                logger.emit(ev)
+                if self.tracer is not None:
+                    self.tracer.instant("watchdog", cat="obs", args=ev)
+
+            try:
+                self.watchdog.check(epoch, probes=probes, loss=loss,
+                                    emit=emit_trip)
+            except WatchdogError:
+                # durability (ISSUE 12 satellite): the evidence must be ON
+                # DISK before the abort unwinds -- close() fsyncs
+                # events.jsonl and writes + fsyncs the Chrome trace, so a
+                # crash right after loses nothing (the outer finally's
+                # close is then an idempotent no-op)
+                if self.tracer is not None:
+                    self.tracer.close()
+                logger.flush()
+                if self.ledger is not None and jax.process_index() == 0:
+                    # process 0 only, like the normal exit path: concurrent
+                    # saves through the shared tmp name would corrupt the
+                    # very snapshot the abort is trying to preserve
+                    self.ledger.save(self._ledger_path())
+                raise
+
+    def _fold_ledger(self, logger: Logger, epoch0: int, k: int, rounds,
+                     uid_rows: Optional[np.ndarray] = None) -> None:
+        """Fold one fetch's rounds into the :class:`ClientLedger` (ISSUE
+        12) and emit the ``{"tag": "ledger"}`` summary -- O(active) per
+        fetch.  ``uid_rows=None`` re-draws the cohort ids from THE one
+        sampling stream (:func:`~..fed.core.superstep_user_schedule`, the
+        host twin of the in-jit draw -- bit-identical by contract), which
+        is exactly the ``ScheduleCommitment.state_for`` alignment: fetch
+        order is dispatch order, so round ``epoch0 + r``'s metric row r
+        IS that draw's cohort in schedule order."""
+        if uid_rows is None:
+            uid_rows = superstep_user_schedule(
+                self.host_key, epoch0, k, self.cfg["num_users"],
+                self.num_active, schedule=self.sched_spec,
+                sampler=self.sampler_spec.kind)
+        tot_active = tot_new = 0
+        last = None
+        for r in range(k):
+            u = uid_rows[r]
+            a = len(u)
+            ms = rounds[r]
+            last = self.ledger.update(epoch0 + r, u,
+                                      np.asarray(ms["rate"])[:a],
+                                      np.asarray(ms["loss_sum"])[:a],
+                                      np.asarray(ms["n"])[:a])
+            tot_active += last["active"]
+            tot_new += last["new_users"]
+        rec = {"event": "ledger", "epoch0": int(epoch0), "k": int(k),
+               "active": tot_active, "new_users": tot_new,
+               "coverage": last["coverage"],
+               "loss_ema_mean": last["loss_ema_mean"],
+               "bytes": self.ledger.nbytes}
+        logger.emit(rec, tag="ledger")
+        if self.tracer is not None:
+            self.tracer.instant("ledger", cat="obs", args=rec)
+
+    def _ledger_path(self) -> str:
+        """Where this run's ``ledger.npz`` snapshot lands: next to the
+        trace artifacts when tracing (the report surface reads them
+        together), else under the run's output dir."""
+        base = os.path.join(self.obs_spec.trace_dir, self.tag) \
+            if self.obs_spec.trace_dir \
+            else os.path.join(self.cfg["output_dir"], "obs", self.tag)
+        return os.path.join(base, "ledger.npz")
 
     def _log_superstep(self, logger: Logger, tag: Dict[str, Any], out):
         """Log one (possibly deferred) superstep's rounds: train metrics per
@@ -905,6 +1002,8 @@ class FedExperiment:
         evals = {e["epoch"]: e for e in (out.get("eval") or [])} \
             if isinstance(out, dict) else {}
         probes = out.get("obs") if isinstance(out, dict) else None
+        if self.ledger is not None:
+            self._fold_ledger(logger, tag["epoch0"], tag["k"], rounds)
         per_round = tag["dt"] / tag["k"]
         for r in range(tag["k"]):
             epoch = tag["epoch0"] + r
@@ -945,17 +1044,23 @@ class FedExperiment:
 
     def _log_train_round(self, logger: Logger, epoch: int, lr: float, dt: float,
                          phases: Dict[str, float], ms: Dict[str, np.ndarray],
-                         probes: Optional[Dict[str, Any]] = None):
+                         probes: Optional[Dict[str, Any]] = None,
+                         uids: Optional[np.ndarray] = None):
         """Log one (possibly deferred) round's train metrics + info lines.
 
         ``probes``: this round's assembled health-probe record (superstep
         fetches carry it pre-split); the K=1 ``train_round`` path still has
         the raw ``obs_*`` leaves riding the metrics dict and splits them
-        here, at the fetch boundary."""
+        here, at the fetch boundary.  ``uids``: the K=1 path's drawn cohort
+        (rides the tag) -- its ledger fold happens here, at the same fetch
+        boundary the superstep path folds at."""
         if probes is None and self.obs_spec.probes:
             ms, plist = split_probes(ms, self.mesh.shape["clients"])
             if plist:
                 probes = plist[0]
+        if uids is not None and self.ledger is not None:
+            self._fold_ledger(logger, epoch, 1, [ms],
+                              uid_rows=np.asarray(uids)[None])
         named = summarize_sums(ms, self.cfg["model_name"])
         logger.append(named, "train", n=float(ms["n"].sum()))
         mean_dt = float(np.mean(self._round_times)) if self._round_times else dt
@@ -983,7 +1088,8 @@ class FedExperiment:
                 self._log_superstep(logger, tag, ms_host)
             else:
                 self._log_train_round(logger, tag["epoch"], tag["lr"], tag["dt"],
-                                      tag["phases"], ms_host)
+                                      tag["phases"], ms_host,
+                                      uids=tag.get("uids"))
 
     def evaluate(self, params, epoch: int, logger: Logger, label_split) -> Dict[str, float]:
         """Host-loop sBN + Local/Global eval -- the ``superstep_rounds=1``
@@ -1070,6 +1176,11 @@ class FedExperiment:
                 # cohort k's in-flight update survives the checkpoint
                 # boundary, so a resumed run replays the exact trajectory
                 self._codec_engine().set_sched_buf(blob["sched_buf"])
+            if blob.get("ledger") is not None and self.ledger is not None:
+                # resume the population ledger (ISSUE 12): counts, EMAs
+                # and level history CONTINUE instead of resetting --
+                # bit-identical to an uninterrupted run (tested)
+                self.ledger.load_state_dict(blob["ledger"])
             if "epoch" in blob:
                 last_epoch = blob["epoch"]
                 pivot = blob.get("pivot", pivot)
@@ -1097,6 +1208,10 @@ class FedExperiment:
                 # point): close on every exit path
                 self.tracer.close()
                 self.phase_timer.trace = None
+            if self.ledger is not None and jax.process_index() == 0:
+                # the ledger.npz snapshot the report surface reads (ISSUE
+                # 12): written on every exit path, aborts included
+                self.ledger.save(self._ledger_path())
 
     def _run_loop(self, logger, pivot_metric, pivot_mode, pivot, epoch,
                   n_rounds, eval_interval, data_split, label_split, params):
@@ -1166,6 +1281,10 @@ class FedExperiment:
                 # boundary (ISSUE 9; None under sync aggregation)
                 "sched_buf": (self._codec_engine().sched_buf_host()
                               if self.sched_spec.buffered else None),
+                # the population ledger at this superstep boundary (ISSUE
+                # 12; None when ledger='off')
+                "ledger": (self.ledger.state_dict()
+                           if self.ledger is not None else None),
                 "pivot": pivot,
                 "logger_history": dict(logger.history),
                 "logger_state": logger.state_dict(),

@@ -47,8 +47,10 @@ import numpy as np
 
 #: cfg['telemetry'] values: 'off' (default) keeps every engine program
 #: bit-identical to the pre-obs tree; 'on' folds the health probes into
-#: the metrics pytree of every fused round
-TELEMETRY_MODES = ("off", "on")
+#: the metrics pytree of every fused round; 'hist' (ISSUE 12) additionally
+#: folds the fixed-bucket cohort histograms (:mod:`.hist`) in -- still
+#: zero new collectives, still the same one-psum/wire budgets
+TELEMETRY_MODES = ("off", "on", "hist")
 
 #: watchdog reactions (cfg['watchdog']['action']): 'warn' (default) emits
 #: a loud warning + structured obs event, 'abort' raises WatchdogError at
@@ -69,6 +71,19 @@ PROBE_PREFIX = "obs_"
 PROBE_FIELDS = ("update_norm", "grad_norm", "participation", "resid_norm",
                 "stale_norm", "nonfinite")
 
+#: the finished cohort-histogram fields of a telemetry='hist' record
+#: (ISSUE 12; each a list of bucket counts -- see obs/hist.py for edges)
+HIST_FIELDS = ("hist_loss", "hist_steps", "hist_level", "hist_stale")
+
+#: hist leaves derived from REPLICATED values: the host takes device 0's
+#: row instead of summing the per-device partials (obs/hist.py emits the
+#: staleness-carry histogram identically on every device)
+HIST_REPLICATED = ("hist_stale",)
+
+#: cfg['ledger'] values: 'on' maintains the host-side ClientLedger
+#: (:mod:`.ledger`) -- O(active) per fetch, never a program change
+LEDGER_MODES = ("off", "on")
+
 
 class WatchdogSpec:
     """Resolved watchdog knobs (one immutable object, the ScheduleSpec
@@ -84,16 +99,40 @@ class WatchdogSpec:
 
 
 class TelemetrySpec:
-    """The resolved telemetry configuration: engines read ``probes``, the
-    driver reads ``watchdog``/``trace_dir``.  Built by
+    """The resolved telemetry configuration: engines read ``probes`` /
+    ``hist``, the driver reads ``watchdog``/``trace_dir``.  Built by
     :func:`resolve_telemetry_cfg` -- there is no second parser."""
 
     def __init__(self, probes: bool = False,
                  watchdog: Optional[WatchdogSpec] = None,
-                 trace_dir: Optional[str] = None):
+                 trace_dir: Optional[str] = None, hist: bool = False):
         self.probes = probes
         self.watchdog = watchdog
         self.trace_dir = trace_dir
+        self.hist = hist
+
+
+class LedgerSpec:
+    """The resolved ledger configuration (ISSUE 12): ``enabled`` turns the
+    driver's per-fetch :class:`~.ledger.ClientLedger` fold on.  Built by
+    :func:`resolve_ledger_cfg` -- there is no second parser."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+
+
+def resolve_ledger_cfg(cfg: Dict[str, Any]) -> LedgerSpec:
+    """Validate ``cfg['ledger']`` and return the :class:`LedgerSpec`.
+
+    THE one validator (the PR 6/8/9 convention): an unknown mode fails
+    loudly at config time, never as a silent ledger-off fallback mid-run.
+    Cross-field constraints (strategy/placement) live in the driver, which
+    owns those facts."""
+    mode = cfg.get("ledger", "off") or "off"
+    if mode not in LEDGER_MODES:
+        raise ValueError(f"Not valid ledger: {mode!r} "
+                         f"(one of {LEDGER_MODES})")
+    return LedgerSpec(enabled=mode == "on")
 
 
 def resolve_telemetry_cfg(cfg: Dict[str, Any]) -> TelemetrySpec:
@@ -112,12 +151,12 @@ def resolve_telemetry_cfg(cfg: Dict[str, Any]) -> TelemetrySpec:
                          f"(one of {TELEMETRY_MODES})")
     raw_wd = cfg.get("watchdog")
     if raw_wd is not None and mode == "off":
-        raise ValueError("cfg['watchdog'] needs telemetry='on': the "
+        raise ValueError("cfg['watchdog'] needs telemetry='on'/'hist': the "
                          "watchdog feeds on the in-program probes (the "
                          "non-finite counter), which telemetry='off' does "
                          "not compute")
     watchdog: Optional[WatchdogSpec] = None
-    if mode == "on":
+    if mode != "off":
         wd = dict(raw_wd or {})
         unknown = set(wd) - {"action", "spike_factor", "window"}
         if unknown:
@@ -148,8 +187,8 @@ def resolve_telemetry_cfg(cfg: Dict[str, Any]) -> TelemetrySpec:
     if trace_dir is not None and not isinstance(trace_dir, str):
         raise ValueError(f"Not valid trace_dir: {trace_dir!r} (a directory "
                          f"path for trace.json + events.jsonl, or None)")
-    return TelemetrySpec(probes=mode == "on", watchdog=watchdog,
-                         trace_dir=trace_dir)
+    return TelemetrySpec(probes=mode != "off", watchdog=watchdog,
+                         trace_dir=trace_dir, hist=mode == "hist")
 
 
 def split_probes(ms: Dict[str, Any], n_dev: int, layout: str = "flat",
@@ -190,6 +229,12 @@ def split_probes(ms: Dict[str, Any], n_dev: int, layout: str = "flat",
             base = name[len(PROBE_PREFIX):]
             if base == "part":
                 rec["participation"] = [float(p) for p in x.sum(axis=0)]
+            elif base.startswith("hist_"):
+                # cohort histograms (ISSUE 12): per-device bucket-count
+                # partials sum across devices; the replicated ones take
+                # device 0's row (obs/hist.py emits them identically)
+                row = x[0] if base in HIST_REPLICATED else x.sum(axis=0)
+                rec[base] = [float(c) for c in row]
             elif base == "resid_sq":
                 rec["resid_norm"] = float(np.sqrt(x.sum()))
             elif base == "nonfinite":

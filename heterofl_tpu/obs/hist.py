@@ -1,0 +1,127 @@
+"""In-program cohort histogram probes (ISSUE 12, the jax half).
+
+PR 10's probes reduced each round to a handful of scalars (norms, counts);
+at a million users the *distribution* across the cohort is the signal the
+ROADMAP's sampler follow-ons need -- which clients lose badly, how hard the
+deadline truncates, where the buffered staleness mass sits.  One function,
+:func:`round_hists`, called next to :func:`~.probes.round_probes` at the
+END of a fused round's in-jit core, computes FIXED-BUCKET histograms over
+quantities the scan already holds:
+
+* ``obs_hist_loss`` -- per-client mean training loss (``loss_sum / n`` per
+  slot), bucketed on :data:`LOSS_EDGES`;
+* ``obs_hist_steps`` -- per-client executed local-step FRACTION under the
+  deadline scheduler (:func:`~..sched.deadline.deadline_steps` is a pure
+  function of ``(round key, uid)``, so the budgets are re-derived here
+  rather than threaded out of the step scan); without a deadline every
+  valid client sits in the full-budget bucket;
+* ``obs_hist_level`` -- per-level cohort membership counts (the width-
+  heterogeneity histogram; its per-level sums equal the ``obs_part``
+  probe, which the host-reference tests pin);
+* ``obs_hist_stale`` -- magnitude histogram of the buffered-async pending
+  update entries (:data:`STALE_EDGES`, log-spaced |value| buckets over the
+  replicated ``[2, total]`` staleness carry); all-zero under sync
+  aggregation.
+
+The hard constraint is the PR 10 one: ZERO new collectives.  Every
+histogram is either a per-device PARTIAL over this device's cohort slots
+(loss/steps/level -- the host sums bucket counts across devices in
+:func:`~heterofl_tpu.obs.split_probes`) or derived from a REPLICATED value
+(the staleness carry -- the host takes device 0's copy).  Bucket edges are
+static arrays, bucketing is one ``searchsorted`` + scatter-add per
+histogram, and the rows ride the engines' existing metrics pytree as
+``obs_hist_*`` keys through the one per-superstep fetch -- staticcheck
+pins the hist-telemetry program variants at the same one-psum / wire /
+donation / step-body budgets as their scalar-probe twins.
+
+Bucket semantics (shared with the host-reference tests, which recompute
+the same ``searchsorted(edges, v, side='left')`` in numpy for EXACT
+equality): bucket ``i`` covers ``(edges[i-1], edges[i]]`` with bucket
+``len(edges)`` collecting overflow, so a histogram row has
+``len(edges) + 1`` bins.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax.numpy as jnp
+
+#: per-client mean-loss bucket edges (upper bounds; cross-entropy scale).
+#: 11 bins: (-inf, .05], (.05, .1], ... (10, 100], (100, inf)
+LOSS_EDGES = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 3.0, 5.0, 10.0, 100.0)
+
+#: executed-step FRACTION edges (deadline truncation; budgets are always
+#: >= ceil(min_frac * total) and <= total, so the (0.875, 1] bucket is the
+#: "met the deadline" bin).  6 bins.
+STEP_EDGES = (0.25, 0.5, 0.75, 0.875, 1.0)
+
+#: |pending buffered update| magnitude edges (log-spaced).  7 bins; exact
+#: zeros land in bin 0.
+STALE_EDGES = (1e-8, 1e-6, 1e-4, 1e-2, 1.0, 100.0)
+
+
+def bucket_counts(values: jnp.ndarray, weights: jnp.ndarray,
+                  edges: Sequence[float]) -> jnp.ndarray:
+    """Weighted fixed-bucket histogram: ``[len(edges) + 1]`` f32 counts of
+    ``values`` under the ``(edges[i-1], edges[i]]`` rule (see module doc).
+    One ``searchsorted`` + one scatter-add -- O(len(values)), no
+    collective."""
+    # staticcheck: allow(no-asarray): trace-time constant -- the static
+    # python edge tuple enters the program once per trace, never per call
+    e = jnp.asarray(edges, jnp.float32)
+    idx = jnp.searchsorted(e, values.astype(jnp.float32), side="left")
+    return jnp.zeros(e.shape[0] + 1, jnp.float32).at[idx].add(
+        weights.astype(jnp.float32))
+
+
+def round_hists(levels: Sequence[float], rate_ms: jnp.ndarray,
+                loss_sum: jnp.ndarray, n: jnp.ndarray,
+                key=None, uids: Optional[jnp.ndarray] = None,
+                total_steps: Optional[int] = None,
+                min_frac: Optional[float] = None,
+                sched_buf: Optional[jnp.ndarray] = None,
+                ) -> Dict[str, jnp.ndarray]:
+    """One round's cohort-histogram leaves, shaped as rank-1 per-device
+    rows (the :func:`~.probes.round_probes` convention).
+
+    ``rate_ms``: the per-slot ``rate * valid`` metric the engines already
+    emit (any rank -- the grouped span layout passes ``[L, slots]``); its
+    nonzeros mark this device's valid participants.  ``loss_sum``/``n``:
+    the per-slot metric sums of the same shape.  ``key``/``uids``/
+    ``total_steps``/``min_frac``: the deadline-budget stream inputs
+    (``min_frac=None`` = no deadline scheduler -> every valid client at
+    fraction 1.0).  ``sched_buf``: the replicated buffered-async carry
+    (None or zeros under sync aggregation)."""
+    rate = jnp.ravel(rate_ms)
+    valid = (rate > 0).astype(jnp.float32)
+    loss = jnp.ravel(loss_sum)
+    nn = jnp.ravel(n)
+    # per-client mean loss: only slots that contributed samples weigh in
+    # (a deadline budget of zero completed steps has no defined loss)
+    w_loss = valid * (nn > 0).astype(jnp.float32)
+    hist_loss = bucket_counts(loss / jnp.maximum(nn, 1.0), w_loss,
+                              LOSS_EDGES)
+    if min_frac is None:
+        frac = jnp.ones_like(rate)
+    else:
+        from ..sched.deadline import deadline_steps
+
+        budgets = deadline_steps(key, jnp.ravel(uids), total_steps,
+                                 min_frac)
+        frac = budgets.astype(jnp.float32) / jnp.float32(total_steps)
+    hist_steps = bucket_counts(frac, valid, STEP_EDGES)
+    hist_level = jnp.stack([jnp.sum((rate == jnp.float32(lvl))
+                                    .astype(jnp.float32))
+                            for lvl in levels])
+    if sched_buf is None:
+        hist_stale = jnp.zeros(len(STALE_EDGES) + 1, jnp.float32)
+    else:
+        flat = jnp.ravel(jnp.abs(sched_buf))
+        hist_stale = bucket_counts(flat, jnp.ones_like(flat), STALE_EDGES)
+    return {
+        "obs_hist_loss": hist_loss,
+        "obs_hist_steps": hist_steps,
+        "obs_hist_level": hist_level,
+        "obs_hist_stale": hist_stale,
+    }

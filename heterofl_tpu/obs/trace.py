@@ -157,10 +157,18 @@ class TraceRecorder:
     def close(self) -> str:
         """Write ``trace.json`` and close the JSONL stream; returns the
         trace path.  Idempotent (a driver finally-block and an explicit
-        close may both run)."""
+        close may both run).
+
+        Durability (ISSUE 12 satellite): both artifacts are fsync'd --
+        close() runs on the abort path BEFORE a ``WatchdogError``
+        propagates, and the buffered tail it would otherwise lose IS the
+        abort evidence (the watchdog instant must be the last event on
+        disk after a crash)."""
         if self.closed:
             return self.trace_path
         self.closed = True
+        self._jsonl.flush()
+        os.fsync(self._jsonl.fileno())
         self._jsonl.close()
         with open(self.trace_path, "w") as f:
             json.dump({"traceEvents": self._events,
@@ -168,4 +176,6 @@ class TraceRecorder:
                        "metadata": {"clock": "perf_counter",
                                     "t0_wall": self._t0_wall}}, f)
             f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
         return self.trace_path

@@ -71,6 +71,7 @@ from ..models import make_model
 from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
 from ..obs import resolve_telemetry_cfg, split_probes
+from ..obs.hist import round_hists
 from ..obs.probes import round_probes
 from ..ops.fused_update import FlatSpec
 from ..sched import resolve_schedule_cfg
@@ -184,6 +185,9 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         # the K=1 host-orchestrated path refuses loudly in train_round
         self._obs_spec = resolve_telemetry_cfg(cfg)
         self._obs_on = self._obs_spec.probes
+        # cohort histograms (ISSUE 12): telemetry='hist' folds the fixed-
+        # bucket hist rows (obs/hist.py) in next to the scalar probes
+        self._obs_hist = self._obs_spec.hist
         # staticcheck: allow(no-float-coercion): constructor-time config
         # parse (the probe level table, a trace-time constant)
         self._obs_levels = sorted({float(r) for r in cfg["model_rate"]},
@@ -581,6 +585,18 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
 
     # -- fused superstep ------------------------------------------------
 
+    def _hist_total_steps(self, x) -> int:
+        """Static per-client local-step total from a data-stack aval (the
+        deadline-budget denominator of the step-fraction histogram, ISSUE
+        12).  Shard shapes are level-invariant, so one number serves every
+        level: vision stacks end ``[..., n, H, W, C]``, LM rows ``[...,
+        T]`` -- eager population stacks and streaming cohort xs alike."""
+        eng0 = next(iter(self.levels.values()))[1]
+        if self.is_lm:
+            return eng0.local_epochs * _ceil_div(int(x.shape[-1]), eng0.bptt)
+        return eng0.local_epochs * _ceil_div(int(x.shape[-4]),
+                                             eng0.batch_size)
+
     def _fused_layout(self):
         """(mode, level boundary table) of the fused round: 'slices' when
         the static row partition exists and there is no data axis (a
@@ -685,15 +701,28 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                 eval_ops = rest[idx + 1 + n_data_args:]
 
             def attach_probes(ms_, p_old, new_p_, tot_s_, tot_c_, nr_=None,
-                              nb_=None):
+                              nb_=None, uids_=None, key_=None, ts_=None):
                 """Fold the in-program health probes into the metrics tree
                 (ISSUE 10): post-psum aggregates + the combined globals,
-                zero new collectives.  Identity under telemetry='off'."""
+                zero new collectives.  Identity under telemetry='off'.
+                ``uids_``/``key_``/``ts_`` (ISSUE 12): the slot-uid rows,
+                round key and static step total the cohort histograms
+                re-derive the deadline budgets from (telemetry='hist')."""
                 if not self._obs_on:
                     return ms_
                 pr = round_probes(self._obs_levels, p_old, new_p_, tot_s_,
                                   tot_c_, ms_["rate"], resid=nr_,
                                   sched_buf=nb_)
+                if self._obs_hist:
+                    # cohort histograms (ISSUE 12): fixed-bucket rows over
+                    # this device's slots of every level it runs -- same
+                    # zero-collective contract as the scalar probes
+                    pr = {**pr, **round_hists(
+                        self._obs_levels, ms_["rate"], ms_["loss_sum"],
+                        ms_["n"], key=key_, uids=uids_, total_steps=ts_,
+                        min_frac=(self._sched_spec.deadline_min_frac
+                                  if self._sched_spec.has_deadline
+                                  else None), sched_buf=nb_)}
                 if mode == "span":
                     # span metric leaves are [L, slots]: rank-pad the probe
                     # rows so the one broadcast out-spec covers the tree
@@ -713,6 +742,12 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                     t, srow = xs
                 key = jax.random.fold_in(base_key, t)
                 lr = lr_const if lr_arg else lr_fn(t)
+                hist_ts = None
+                if self._obs_hist and self._sched_spec.has_deadline:
+                    # the step-fraction histogram's static denominator
+                    # (ISSUE 12) -- from the data aval, level-invariant
+                    hist_ts = self._hist_total_steps(d[0] if streaming
+                                                     else data[0])
                 if per_level:
                     # per-level codec selection (ISSUE 9 satellite): each
                     # level's SLICED counted sums join the round's ONE psum
@@ -770,7 +805,8 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                         tot_c = c_e if tot_c is None else \
                             {n: tot_c[n] + c_e[n] for n in tot_c}
                     new_p = combine_counted(p, tot_s, tot_c)
-                    ms = attach_probes(ms, p, new_p, tot_s, tot_c, nr_=nr)
+                    ms = attach_probes(ms, p, new_p, tot_s, tot_c, nr_=nr,
+                                       uids_=srow, key_=key, ts_=hist_ts)
                     return (new_p, nr), ms
                 if mode == "span":
                     # srow: [L, per_dev] -- this device's slots of EVERY level
@@ -826,11 +862,13 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                     new_p, nb = buffered_combine(p, sb, tot_s, tot_c,
                                                  FlatSpec.of(p),
                                                  self._sched_spec.staleness)
-                    ms = attach_probes(ms, p, new_p, tot_s, tot_c, nb_=nb)
+                    ms = attach_probes(ms, p, new_p, tot_s, tot_c, nb_=nb,
+                                       uids_=srow, key_=key, ts_=hist_ts)
                     return (new_p, nb), ms
                 new_p = combine_counted(p, tot_s, tot_c)
                 ms = attach_probes(ms, p, new_p, tot_s, tot_c,
-                                   nr_=nr if codec else None)
+                                   nr_=nr if codec else None,
+                                   uids_=srow, key_=key, ts_=hist_ts)
                 return ((new_p, nr) if codec else new_p), ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)

@@ -38,6 +38,7 @@ except ImportError:  # pragma: no cover
 from ..compress import make_codec, resid_slots, resolve_codec_cfg
 from ..config import resolve_prefetch_depth
 from ..obs import resolve_telemetry_cfg, split_probes
+from ..obs.hist import round_hists
 from ..obs.probes import round_probes
 from ..data.datasets import DATASET_STATS
 from ..fed.core import combine_counted, round_rates, round_users
@@ -368,6 +369,9 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         # 'off' (default) leaves every program bit-identical to pre-obs.
         self._obs_spec = resolve_telemetry_cfg(cfg)
         self._obs_on = self._obs_spec.probes
+        # cohort histograms (ISSUE 12): telemetry='hist' folds the fixed-
+        # bucket hist rows (obs/hist.py) in next to the scalar probes
+        self._obs_hist = self._obs_spec.hist
         # staticcheck: allow(no-float-coercion): constructor-time config
         # parse (the probe level table, a trace-time constant)
         self._obs_levels = sorted({float(r) for r in cfg["model_rate"]},
@@ -828,6 +832,21 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             ms = {**ms, **round_probes(self._obs_levels, params, new_params,
                                        summed, counts, ms["rate"],
                                        resid=new_resid, sched_buf=new_buf)}
+            if self._obs_hist:
+                # cohort histograms (ISSUE 12): fixed-bucket rows over the
+                # per-slot metric sums this device already holds -- same
+                # zero-collective contract as the scalar probes, same
+                # metrics out-spec ride to the host.  total_steps is THE
+                # denominator the deadline branches above budgeted against
+                # (defined exactly when has_deadline).
+                ms = {**ms, **round_hists(
+                    self._obs_levels, ms["rate"], ms["loss_sum"], ms["n"],
+                    key=key, uids=ugid,
+                    total_steps=(total_steps
+                                 if self._sched_spec.has_deadline else None),
+                    min_frac=(self._sched_spec.deadline_min_frac
+                              if self._sched_spec.has_deadline else None),
+                    sched_buf=new_buf)}
         return new_params, ms, new_resid, new_buf
 
     def _data_specs(self) -> Tuple[P, ...]:

@@ -44,6 +44,11 @@ statically enforces:
     program variants carry the in-program health probes at ZERO wire cost:
     same single global psum, same wire bytes by equality, full donation,
     and the k1 step body inside the unchanged kernel budget;
+(l) **cohort histograms** (ISSUE 12, :mod:`..obs.hist`) -- the
+    ``telemetry='hist'`` variants carry the fixed-bucket cohort
+    histograms next to the scalar probes at the SAME budgets: one global
+    psum, wire bytes by equality (dense AND int8-codec), full/resid-only
+    donation, unchanged k1 step body;
 (k) **sampler** (ISSUE 11, :mod:`..fed.sampling`) -- both sampler kinds'
     in-jit draws audited as programs (the legacy ``perm`` superstep stays
     a pinned variant next to the default ``prp`` one, same psum/wire/
@@ -113,6 +118,9 @@ STEP_BODY_FUSION_BUDGET = {
     # inside the local-step scan body -- the telemetry-on k1 program is
     # held to the SAME step-body budget as its dense twin
     "masked/replicated/k1-telemetry": 60,
+    # ISSUE 12: the cohort histograms are round-level bucketing over the
+    # already-emitted per-slot metric sums -- same unchanged step body
+    "masked/replicated/k1-hist": 60,
 }
 
 
@@ -840,6 +848,90 @@ def _obs_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
     return targets
 
 
+def _obs_hist_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
+    """Cohort-histogram telemetry variants (ISSUE 12): ``telemetry='hist'``
+    folds the fixed-bucket cohort histograms (obs/hist.py: per-client
+    loss, deadline step fraction, level membership, buffered staleness
+    magnitude) into the metrics pytree NEXT TO the scalar probes -- and
+    these targets pin the same zero-cost contract the ISSUE 10 variants
+    pin: IDENTICAL single-global-psum, wire-byte (by equality), donation
+    and step-body budgets as the scalar-probe/dense twins.  The bucketing
+    is one searchsorted + scatter-add per histogram over per-slot values
+    each device already holds -- per-device partials riding the metrics
+    out-spec, never a collective.  The int8 variant proves the histograms
+    ride the codec programs at the compressed wire budget and resid-only
+    donation unchanged."""
+    import jax
+
+    from ..compress import resid_slots
+    from ..fed.core import level_codec_byte_table
+    from ..ops.fused_update import FlatSpec
+    from ..parallel import GroupedRoundEngine, RoundEngine
+    from ..parallel.grouped import _bucket_pow2
+    from ..utils.optim import make_traced_lr_fn
+
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    params, key, lr = setup["params"], setup["key"], setup["lr"]
+    users = setup["users"]
+    n_dev = mesh.shape["clients"]
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    bt = setup["byte_table"]
+    top = max(bt)
+    wire = bt[top]["wire_bytes"]
+    k = 8
+    a = int(math.ceil(cfg["frac"] * users))
+    per_dev = _ceil_div(a, n_dev)
+    per_level = 2
+    per_dev_g = _bucket_pow2(_ceil_div(per_level, n_dev))
+    targets = []
+
+    def mem(cpd: int) -> Dict[str, int]:
+        return _mem_expect(bt, top, cpd)
+
+    hcfg = dict(cfg, telemetry="hist")
+    eng = RoundEngine(model, hcfg, mesh)
+    eng._lr_fn = make_traced_lr_fn(cfg)
+    fix = (eng.fix_rates,) if eng.fix_rates is not None else ()
+    data = tuple(setup["data"]) + fix
+    slots = users + ((-users) % n_dev)
+    targets.append((
+        "masked/replicated/k1-hist", eng._build_train(),
+        (params, key, lr, _sds((slots,)), _sds((slots,))) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(_ceil_div(slots, n_dev))}))
+    targets.append((
+        "masked/replicated/k8-hist",
+        eng._build_superstep(k, per_dev, True, num_active=a),
+        (params, key, np.int32(1)) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev)}))
+
+    grp = GroupedRoundEngine(hcfg, mesh)
+    grp._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "grouped/span/k8-fused-hist",
+        grp._superstep_prog(k, per_dev_g, "span"),
+        (params, key, np.int32(1),
+         _sds((k, len(grp.levels), per_dev_g * n_dev))) + data[:4],
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev_g)}))
+
+    total = FlatSpec.of(params).total
+    ceng = RoundEngine(model, dict(cfg, telemetry="hist", wire_codec="int8"),
+                       mesh)
+    ceng._lr_fn = make_traced_lr_fn(cfg)
+    wire_i8 = level_codec_byte_table(cfg, "int8", n_leaves=n_leaves)[top]
+    resid_bytes = n_dev * resid_slots("int8") * total * 4
+    targets.append((
+        "masked/replicated/k8-hist-int8",
+        ceng._build_superstep(k, per_dev, True, num_active=a),
+        (params, _sds((n_dev, resid_slots("int8"), total), np.float32), key,
+         np.int32(1)) + data,
+        {"donated": 1, "psum": PSUM_BUDGET, "wire_bytes": wire_i8,
+         "donated_bytes": resid_bytes, "mem": mem(per_dev)}))
+    return targets
+
+
 def codec_frontier_check(report: "AuditReport") -> Dict[str, Any]:
     """The analytic flagship compression frontier (ISSUE 8 acceptance): each
     codec's per-round payload at full CIFAR-10 ResNet-18 widths vs the
@@ -1307,6 +1399,7 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
     targets.extend(_codec_targets(setup))
     targets.extend(_sched_targets(setup))
     targets.extend(_obs_targets(setup))
+    targets.extend(_obs_hist_targets(setup))
     for name, prog, args, expect in targets:
         report.add_program(audit_program(name, prog, args, expect, mesh))
 

@@ -73,11 +73,12 @@ _PARALLEL = ("heterofl_tpu/parallel/",)
 #: config.py) and stays out of scope: its float()/rng calls parse host
 #: config, never device values.
 _SCHED = ("heterofl_tpu/sched/deadline", "heterofl_tpu/sched/buffer")
-#: the telemetry jax half (ISSUE 10): obs/probes.py computes the health
-#: probes inside the fused round -- hot-path code under the same rules.
-#: obs/__init__ (config validation + host probe assembly), obs/trace and
-#: obs/watchdog are host-side recorders like sched/__init__ and stay out.
-_OBS = ("heterofl_tpu/obs/probes",)
+#: the telemetry jax halves (ISSUE 10/12): obs/probes.py computes the
+#: health probes and obs/hist.py the cohort histograms inside the fused
+#: round -- hot-path code under the same rules.  obs/__init__ (config
+#: validation + host probe assembly), obs/trace, obs/watchdog, obs/ledger
+#: and obs/report are host-side (numpy) like sched/__init__ and stay out.
+_OBS = ("heterofl_tpu/obs/probes", "heterofl_tpu/obs/hist")
 _KERNEL = ("heterofl_tpu/ops/", "heterofl_tpu/models/",
            "heterofl_tpu/compress/") + _SCHED + _OBS
 _TRACED = ("heterofl_tpu/parallel/", "heterofl_tpu/fed/") + _KERNEL

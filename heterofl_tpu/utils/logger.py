@@ -132,15 +132,16 @@ class Logger:
             self._jsonl.flush()
         return line
 
-    def emit(self, event: Dict[str, object]) -> None:
+    def emit(self, event: Dict[str, object], tag: str = "obs") -> None:
         """Structured obs event on the existing JSONL writer (ISSUE 10):
-        one ``{"tag": "obs", "t": ..., **event}`` line next to the metric
-        records, so probe snapshots and watchdog trips land in the same
-        ``log.jsonl`` a run already produces.  No-op while the writer is
-        closed (outside a ``safe(True)`` window) -- obs events are
-        advisory, never worth crashing a checkpoint boundary over."""
+        one ``{"tag": tag, "t": ..., **event}`` line next to the metric
+        records, so probe snapshots, watchdog trips and ledger summaries
+        (``tag="ledger"``, ISSUE 12) land in the same ``log.jsonl`` a run
+        already produces.  No-op while the writer is closed (outside a
+        ``safe(True)`` window) -- obs events are advisory, never worth
+        crashing a checkpoint boundary over."""
         if self._jsonl is not None:
-            self._jsonl.write(json.dumps({"tag": "obs", "t": time.time(),
+            self._jsonl.write(json.dumps({"tag": tag, "t": time.time(),
                                           **event}) + "\n")
             self._jsonl.flush()
 
