@@ -95,6 +95,14 @@ rounds/sec figure measured through a diverging run is not a telemetry
 overhead.  Needs BENCH_SUPERSTEP>1 for the grouped strategy; ignored in
 population mode (the A/B measures the eager flagship program).
 
+BENCH_ARMS=E (ISSUE 14): the experiment-arms multiplexer A/B -- ONE E-arm
+fused superstep program vs E serial solo runs on equal per-arm devices,
+into extra.arms (aggregate arm-rounds/sec both ways, speedup, compile
+counts, peak RSS).  BENCH_ARMS_PLACEMENT=mesh (default when the device
+count divides: each arm on its own mesh rows, executing concurrently) or
+vmap (batched per device).  Needs BENCH_SUPERSTEP>1; skipped under
+population/scenario/codec knobs.
+
 BENCH_LEDGER=1 (ISSUE 12): the population-observatory A/B -- one measure
 with telemetry='hist' (cohort histograms riding the metrics fetch) PLUS a
 host-side ClientLedger folded O(active) per fetch from the recomputed
@@ -1051,6 +1059,7 @@ def main():
 
     step_ab = {}  # filled by the BENCH_STEP_AB pass; emitted when non-empty
     obs_ab = {}   # filled by the BENCH_TELEMETRY pass; emitted when non-empty
+    arms_ab = {}  # filled by the BENCH_ARMS pass (ISSUE 14)
 
     def emit(ctx, rounds_done, strategies=None):
         # a degraded (non-flagship-volume / wrong-platform) run must not
@@ -1124,6 +1133,7 @@ def main():
                       **({"strategies": strategies} if strategies else {}),
                       **({"step_ab": step_ab} if step_ab else {}),
                       **({"obs": obs_ab} if obs_ab else {}),
+                      **({"arms": arms_ab} if arms_ab else {}),
                       **({"degraded": degraded} if degraded else {})},
         }), flush=True)
 
@@ -1470,6 +1480,111 @@ def main():
             obs_ab["ledger"] = {"error": repr(e)}
             print(f"bench: ledger A/B failed: {e!r}", file=sys.stderr)
         emit(ctx, timed_rounds, strategies=strategies or None)
+
+    # BENCH_ARMS=E (ISSUE 14): the experiment-arms multiplexer A/B -- ONE
+    # E-arm fused superstep program vs E SERIAL solo runs, both through the
+    # shared measure() procedure on equal per-arm device resources.  The
+    # default placement lays the arms over a dedicated mesh axis
+    # (make_mesh(n_arms=E): each arm's federation on its own device rows,
+    # executing concurrently -- the mesh-filling story); BENCH_ARMS_
+    # PLACEMENT=vmap forces the batched-per-device layout instead (the two
+    # are bitwise-identical per arm, tests/test_arms.py).  The serial
+    # baseline runs ONE arm on the per-arm submesh -- E sequential such
+    # runs is the reference's process-grid shape with the compile already
+    # amortized, so the steady-state speedup under-counts the reference's
+    # per-process compile (reported separately via compile_sec).  Records
+    # aggregate ARM-rounds/sec both ways, program/compile counts and RSS
+    # into extra.arms.  Skipped in population mode and under scenario/
+    # codec knobs (the A/B measures the plain dense program).
+    bench_arms = env_int("BENCH_ARMS", 0)
+    if bench_arms:
+        if population or sched_cfg or wire_codec != "dense":
+            print("bench: BENCH_ARMS ignored with population/scenario/codec "
+                  "knobs (the A/B measures the plain dense program)",
+                  file=sys.stderr)
+        elif superstep <= 1:
+            print("bench: BENCH_ARMS needs BENCH_SUPERSTEP>1 (arms ride "
+                  "the fused superstep); skipping the A/B", file=sys.stderr)
+        else:
+            import resource
+
+            try:
+                E = bench_arms
+                n_dev_total = len(devs)
+                placement = os.environ.get("BENCH_ARMS_PLACEMENT") or \
+                    ("mesh" if n_dev_total % E == 0
+                     and n_dev_total >= E else "vmap")
+                if placement not in ("mesh", "vmap"):
+                    print(f"bench: unknown BENCH_ARMS_PLACEMENT="
+                          f"{placement!r}; using mesh", file=sys.stderr)
+                    placement = "mesh"
+                if placement == "mesh":
+                    sub_clients = n_dev_total // E
+                    arms_mesh = make_mesh(sub_clients, 1, n_arms=E)
+                    solo_mesh = make_mesh(sub_clients, 1)
+                else:
+                    sub_clients = mesh.shape["clients"]
+                    arms_mesh = mesh
+                    solo_mesh = mesh
+                hb(f"arms A/B: E={E} placement={placement} "
+                   f"({E}x{sub_clients} of {n_dev_total} devices)")
+                solo_eng = RoundEngine(model, dict(cfg), solo_mesh)
+                serial_sum, _ = measure("masked", solo_eng,
+                                        model.init(jax.random.key(0)),
+                                        PhaseTimer(),
+                                        hb_prefix="arms-serial ")
+                rss_serial = resource.getrusage(
+                    resource.RUSAGE_SELF).ru_maxrss
+                arms_eng = RoundEngine(model, dict(cfg, arms=E), arms_mesh)
+                p0 = model.init(jax.random.key(0))
+                p_stack = jax.tree_util.tree_map(
+                    lambda v: jnp.stack([v] * E), p0)
+
+                # the fetch must charge THIS measure()'s timer, not the
+                # already-summarized primary pass's (the serial baseline
+                # pays fetch through measure's own tmr -- like-for-like)
+                arms_tmr = PhaseTimer()
+
+                def arms_fetch(r, pending, ctx):
+                    with arms_tmr.phase("fetch"):
+                        out = pending.fetch()
+                    a0 = out["arms"][0]
+                    ctx["ms"] = a0["train"][-1] if isinstance(a0, dict) \
+                        else a0[-1]
+
+                arms_sum, _ = measure("masked", arms_eng, p_stack,
+                                      arms_tmr,
+                                      hb_prefix=f"arms-E{E} ",
+                                      on_round=arms_fetch)
+                rss_arms = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                agg_arms = E / arms_sum["round_sec_steady_avg"]
+                agg_serial = 1.0 / serial_sum["round_sec_steady_avg"]
+                arms_ab.update({
+                    "E": E, "placement": placement,
+                    "mesh": {"arms": E if placement == "mesh" else 0,
+                             "clients_per_arm": sub_clients,
+                             "total_devices": n_dev_total},
+                    "one_program": arms_sum,
+                    "serial_per_arm": serial_sum,
+                    "aggregate_arm_rounds_per_sec": round(agg_arms, 4),
+                    "serial_aggregate_arm_rounds_per_sec":
+                        round(agg_serial, 4),
+                    "speedup": round(agg_arms / agg_serial, 4),
+                    # one compiled program + one warmup dispatch serve all
+                    # E arms; the reference's process grid compiles E times
+                    "compile_count": {"one_program": 1, "serial_runs": E},
+                    "compile_sec": {
+                        "one_program": arms_sum["compile_sec"],
+                        "serial_per_run": serial_sum["compile_sec"]},
+                    # ru_maxrss is the process PEAK (monotonic): the delta
+                    # after the arms pass bounds its extra footprint
+                    "rss_max_kb": {"after_serial": rss_serial,
+                                   "after_arms": rss_arms},
+                })
+            except Exception as e:
+                arms_ab.update({"error": repr(e)})
+                print(f"bench: arms A/B failed: {e!r}", file=sys.stderr)
+            emit(ctx, timed_rounds, strategies=strategies or None)
 
 
 if __name__ == "__main__":

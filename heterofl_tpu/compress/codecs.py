@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from . import (COUNT_LANE_BITS, SIGN_LANE_BITS, TOPK_BLOCKS, VALUE_LANE_BITS,
-               codec_payload_bytes, resid_slots)
+               codec_payload_bytes, lane_words, resid_slots)
 from ..ops.quant import pack_lanes, quantize_pack, unpack_lanes
 
 #: PRNG salts of the codec streams (disjoint from the engines' 13/98 and
@@ -74,6 +74,19 @@ class WireCodec:
         if self.axis is not None:
             k = jax.random.fold_in(k, jax.lax.axis_index(self.axis))
         return k
+
+    def zero_payload(self):
+        """The codec's IDENTITY payload: what a non-participating device
+        ships into the shared psum bind so the accumulated payload decodes
+        as if that device contributed nothing.  All-zero for every codec
+        -- int8 lanes carry ``+bias`` per PARTICIPANT and the decoder
+        subtracts ``participants x bias``, signsgd's decode subtracts
+        ``participants`` from the doubled positive count, and topk/dense
+        ship raw values -- PROVIDED the codec was constructed with
+        ``participants`` = the devices that actually encode (the grouped
+        ``slices`` per-level layout, ISSUE 14 satellite: each level's
+        codec counts its slice rows, every other row ships this)."""
+        raise NotImplementedError
 
     def _check_count_capacity(self, cmax: int, lane_bits: int) -> None:
         """Counts ride exact integer lanes: the cross-device lane sum (at
@@ -119,6 +132,11 @@ class Int8Codec(WireCodec):
         if mode is None:
             mode = "pallas" if jax.default_backend() == "tpu" else "xla"
         self.mode = mode
+
+    def zero_payload(self):
+        n = self.spec.total
+        return {"q": jnp.zeros(lane_words(n, VALUE_LANE_BITS), jnp.int32),
+                "c": jnp.zeros(lane_words(n, COUNT_LANE_BITS), jnp.int32)}
 
     def _scale_flat(self, params: Dict[str, jnp.ndarray],
                     cmax: int) -> jnp.ndarray:
@@ -170,6 +188,12 @@ class SignSGDCodec(WireCodec):
                 f"signsgd wire codec supports at most "
                 f"{(1 << SIGN_LANE_BITS) - 1} participants on the reduction "
                 f"axis (got {self.p}): the sign lanes would carry")
+
+    def zero_payload(self):
+        n = self.spec.total
+        return {"b": jnp.zeros(lane_words(n, SIGN_LANE_BITS), jnp.int32),
+                "s": jnp.zeros(len(self.spec.names), jnp.float32),
+                "c": jnp.zeros(lane_words(n, COUNT_LANE_BITS), jnp.int32)}
 
     def _leaf_means(self, x: jnp.ndarray) -> jnp.ndarray:
         ax = jnp.abs(x)
@@ -226,6 +250,10 @@ class TopKCodec(WireCodec):
             raise ValueError(f"topk wire codec needs at least {self.blocks} "
                              f"flat elements (got {spec.total})")
         self.block_len = -(-spec.total // self.blocks)
+
+    def zero_payload(self):
+        return {"v": jnp.zeros(self.block_len, jnp.float32),
+                "c": jnp.zeros(self.block_len, jnp.float32)}
 
     def _offset(self, key: jax.Array) -> jnp.ndarray:
         # identical on every device: derived from the (replicated) round key

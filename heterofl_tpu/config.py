@@ -281,6 +281,23 @@ DEFAULT_CFG: Dict[str, Any] = {
     # strategy and replicated/streaming placement (the sharded slot
     # packing drops the uid ordering the fold consumes).
     "ledger": "off",
+    # experiment arms multiplexer (ISSUE 14, heterofl_tpu/multi/): batch E
+    # sweep arms into ONE fused superstep program.  None (default) = single
+    # trajectory, every program byte-identical to pre-arms.  An int E (or a
+    # dict {"count": E, "seeds": [...], "lr_scales": [...]}) vmaps the
+    # K-round scan over a leading arms axis: per-arm PRNG streams
+    # (fed.core.arm_stream_keys; seed None = the base stream), per-arm LR
+    # scales over the shared schedule shape, metrics/eval stacked [E, K,
+    # ...], still EXACTLY one global psum per fused round (wire bytes and
+    # FLOPs scale linearly in E -- staticcheck arms variants audit this by
+    # equality).  Arm i of a batched run is bitwise-identical to a solo
+    # arms=1 run with the same seed.  Structural knobs (strategy, codec,
+    # placement, schedule kind) stay per-program; unsupported combos --
+    # sliced strategy, per-level codec maps, buffered aggregation, the
+    # streaming store, grouped 'slices' placement, telemetry with grouped
+    # -- refuse loudly.  python -m heterofl_tpu.multi.sweep partitions a
+    # grid spec into arm batches x structural launches.
+    "arms": None,
     # watchdog knobs (telemetry='on' enables it at warn defaults): a dict
     # {"action": "warn"|"abort"|"off", "spike_factor": 3.0, "window": 8} --
     # non-finite params and loss-spikes-vs-rolling-median trip at fetch
@@ -514,6 +531,12 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
 
     resolve_telemetry_cfg(cfg)
     resolve_ledger_cfg(cfg)
+    # arms validation (ISSUE 14): malformed counts/seed vectors fail HERE,
+    # never as a silent single-arm fallback mid-run (multi/ is import-light
+    # like sched/ and obs/)
+    from .multi import resolve_arms_cfg
+
+    resolve_arms_cfg(cfg)
     return cfg
 
 

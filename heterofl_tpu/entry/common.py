@@ -39,9 +39,11 @@ from ..data import (
     stack_client_shards,
     stack_client_token_rows,
 )
-from ..fed.core import (round_rates, round_users, superstep_rate_schedule,
-                        superstep_user_schedule, validate_width_geometry)
+from ..fed.core import (arm_stream_keys, round_rates, round_users,
+                        superstep_rate_schedule, superstep_user_schedule,
+                        validate_width_geometry)
 from ..fed.sampling import ScheduleCommitment, resolve_sampler_cfg
+from ..multi import resolve_arms_cfg
 from ..sched import resolve_schedule_cfg
 from ..models import make_model
 from ..parallel import (ClientStore, MetricsPipeline, PendingMetrics,
@@ -172,6 +174,10 @@ class FedExperiment:
     """One federated experiment (one seed): owns the data staging, engine,
     evaluator, logger and checkpoint loop."""
 
+    #: experiment arms (ISSUE 14) need the multiplexed driver loop --
+    #: :class:`ArmsExperiment` flips this; the base loop refuses loudly
+    _arms_capable = False
+
     def __init__(self, cfg: Dict[str, Any], seed: int):
         self.cfg = cfg
         self.seed = seed
@@ -190,9 +196,19 @@ class FedExperiment:
         validate_width_geometry(self.model, cfg)
         n_data = max(1, cfg["mesh"].get("data", 1))
         n_clients = cfg["mesh"].get("clients", 0) or None
+        # arms mesh axis (ISSUE 14): cfg['mesh']['arms'] = E lays each
+        # experiment arm on its own device rows (the 'experiments' mesh
+        # dimension); 0/absent keeps the (clients, data) mesh and the
+        # vmap arms placement
+        n_arms_axis = max(1, int(cfg["mesh"].get("arms", 1) or 1))
         try:
-            self.mesh = make_mesh(n_clients, n_data)
+            self.mesh = make_mesh(n_clients, n_data, n_arms=n_arms_axis)
         except (ValueError, AssertionError):
+            if n_arms_axis > 1:
+                # an explicit arms mesh axis must not silently degrade to
+                # the vmap placement -- the user asked for one arm per
+                # device-row group, and the device count cannot honor it
+                raise
             self.mesh = make_mesh(len(jax.devices()), 1)
         self.engine = RoundEngine(self.model, cfg, self.mesh)
         self.evaluator = Evaluator(self.model, cfg, self.mesh, seed=seed)
@@ -441,6 +457,44 @@ class FedExperiment:
             self.ledger = ClientLedger(
                 cfg["num_users"],
                 sorted({float(r) for r in cfg["model_rate"]}, reverse=True))
+        # experiment arms (ISSUE 14, heterofl_tpu/multi/): the base driver
+        # runs ONE trajectory -- a multiplexed cfg must go through the
+        # ArmsExperiment loop (per-arm checkpoints/logs/Plateau state),
+        # which python -m heterofl_tpu.multi.sweep drives
+        self.arms_spec = resolve_arms_cfg(cfg)
+        if self.arms_spec is not None and not self._arms_capable:
+            raise ValueError(
+                "cfg['arms'] needs the multiplexed driver loop: run the "
+                "sweep front-end (python -m heterofl_tpu.multi.sweep) or "
+                "construct entry.common.ArmsExperiment directly -- the "
+                "single-trajectory FedExperiment loop cannot thread "
+                "per-arm checkpoints/logs")
+        if self.arms_spec is not None:
+            if cfg.get("strategy") == "sliced":
+                raise ValueError(
+                    "arms need a mesh-native strategy ('masked' or "
+                    "'grouped'): the sliced debug twin replays the "
+                    "reference host loop one trajectory at a time")
+            if self.ledger_spec.enabled:
+                raise ValueError(
+                    "ledger='on' cannot combine with arms yet: the "
+                    "O(active) fold consumes ONE sampling stream's cohort "
+                    "rows, and each arm draws its own (a ROADMAP "
+                    "follow-on)")
+            if self.obs_spec.trace_dir:
+                raise ValueError(
+                    "trace_dir cannot combine with arms yet: the "
+                    "multiplexed loop does not build the TraceRecorder, "
+                    "so the trace would be silently empty (a ROADMAP "
+                    "follow-on; per-arm probes/watchdog DO run)")
+            if "arms" in self.mesh.axis_names and jax.process_count() > 1:
+                raise ValueError(
+                    "the arms mesh placement cannot run multi-process "
+                    "yet: params commit sharded over the arms axis and "
+                    "the checkpoint path materializes them with "
+                    "np.asarray, which needs fully-addressable arrays (a "
+                    "ROADMAP follow-on; the vmap placement replicates "
+                    "and works on pods)")
         self._eval_widx = None  # rolling Local-eval window currently staged
         self._fused = None  # FusedEval, built on first eval-bearing superstep
         self.alt_engine = None
@@ -1305,6 +1359,243 @@ class FedExperiment:
         self._drain_metrics(logger)  # safety: nothing stays on device at exit
         return {"params": params, "bn_state": getattr(self, "bn_state", {}),
                 "logger": logger, "data_split": data_split, "label_split": label_split}
+
+
+class ArmsExperiment(FedExperiment):
+    """The multiplexed driver loop (ISSUE 14, heterofl_tpu/multi/): E
+    trace-compatible experiment arms in ONE fused superstep program per
+    dispatch.
+
+    Reuses the base experiment's staging, engines, evaluator and schedule
+    helpers; the loop differs where the arms axis surfaces on the host --
+    per-arm init trees (each arm's stream root seeds its own
+    ``model.init``), per-arm ``{"tag": "arms"}`` JSONL log lines carrying
+    an ``arm`` field, per-arm ReduceLROnPlateau state (one scheduler per
+    arm, stepped on that arm's own fused-eval Global loss, staged into the
+    program as the ``[E]`` LR vector), per-arm best-pivot tracking, and
+    per-arm checkpoints (one exportable blob per arm next to the
+    multiplexed resume blob).  Fetches are synchronous (one fetch per
+    superstep serves all E arms -- the arms win is batching compute, not
+    deferring metrics)."""
+
+    _arms_capable = True
+
+    def __init__(self, cfg: Dict[str, Any], seed: int):
+        super().__init__(cfg, seed)
+        if self.arms_spec is None:
+            raise ValueError("ArmsExperiment needs cfg['arms'] (an int "
+                             "count or a {count, seeds, lr_scales} dict)")
+        self._plateau = isinstance(self.scheduler, PlateauScheduler)
+        # per-arm Plateau state: each arm owns a scheduler instance stepped
+        # on its OWN eval metrics (the solo loop's semantics, per arm); the
+        # arm's lr_scale multiplies the scheduler's output either way, so a
+        # Plateau LR sweep still trains each arm at ITS grid value
+        self._arm_scheds = [make_scheduler(self.cfg)
+                            for _ in range(self.arms_spec.count)] \
+            if self._plateau else None
+        # per-arm watchdogs: the spike detector's rolling loss window is
+        # per trajectory -- one shared Watchdog would mix E loss streams
+        self._arm_watchdogs = ([Watchdog(self.obs_spec.watchdog)
+                                for _ in range(self.arms_spec.count)]
+                               if self.watchdog is not None else None)
+        self._staged_lr_vec = None  # the [E] LR vector of the live dispatch
+
+    def _arms_tag(self) -> str:
+        return f"{self.tag}_arms{self.arms_spec.count}"
+
+    def _arm_tag(self, e: int) -> str:
+        return f"{self._arms_tag()}_a{e}"
+
+    def _arm_lr(self, e: int, epoch: int) -> float:
+        sched = self._arm_scheds[e] if self._plateau else self.scheduler
+        return float(sched(epoch)) * self.arms_spec.lr_scales[e]
+
+    def _observe_arm(self, logger: Logger, e: int, epoch: int,
+                     probes: Dict[str, Any], ms) -> None:
+        """The solo loop's :meth:`_observe` with the arms axis: the probes
+        event carries the ``arm`` field and each arm feeds ITS OWN
+        watchdog (the spike window is per trajectory)."""
+        loss = None
+        n = float(np.sum(ms["n"]))
+        if n > 0:
+            loss = float(np.sum(ms["loss_sum"])) / n
+        logger.emit({"event": "probes", "arm": e, "epoch": int(epoch),
+                     "loss": loss, **probes})
+        if self._arm_watchdogs is not None:
+            try:
+                self._arm_watchdogs[e].check(
+                    epoch, probes=probes, loss=loss,
+                    emit=lambda ev: logger.emit({**ev, "arm": e}))
+            except WatchdogError:
+                # abort evidence must be ON DISK before the unwind (the
+                # solo loop's durability contract; arms runs have no
+                # tracer/ledger -- both are refused at construction)
+                logger.flush()
+                raise
+
+    def _init_params(self):
+        """Stacked per-arm init trees: arm e's params come from ITS stream
+        root (``fold_in(arm_root, 0)``, the solo loop's derivation), so the
+        identity arm inits exactly like a solo run."""
+        roots = arm_stream_keys(self.host_key, self.arms_spec.seeds)
+        trees = [self.model.init(jax.random.fold_in(roots[e], 0))
+                 for e in range(self.arms_spec.count)]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+    def _dispatch(self, params, epoch0: int, k: int, mask):
+        """One multiplexed superstep: the engines batch the arms axis; the
+        driver supplies shared schedules (grouped/sharded) and the per-arm
+        LR vector under Plateau."""
+        fused = self._fused_eval(None) if any(mask) else None
+        lr_vec = np.asarray(
+            [s(epoch0) * sc for s, sc in zip(self._arm_scheds,
+                                             self.arms_spec.lr_scales)],
+            np.float32) if self._plateau else None
+        # the fetch loop steps the Plateau schedulers mid-superstep; the
+        # logged LR must be what THIS dispatch actually staged, not the
+        # scheduler's post-step value (the solo loop pins lrs pre-fetch)
+        self._staged_lr_vec = lr_vec
+        if self.cfg.get("strategy") == "grouped":
+            users = self._superstep_schedule(epoch0, k)
+            rates = superstep_rate_schedule(self.host_key, epoch0, k,
+                                            self.cfg, users)
+            return self.alt_engine.train_superstep(
+                params, self.host_key, epoch0, k, users, rates,
+                self.train_data, timer=self.phase_timer,
+                eval_mask=mask if fused else None, fused_eval=fused,
+                lr=lr_vec)
+        sched = None
+        if self.cfg.get("data_placement") == "sharded":
+            sched = self._superstep_schedule(epoch0, k)
+        return self.engine.train_superstep(
+            params, self.host_key, epoch0, k, self.train_data,
+            user_schedule=sched, num_active=self.num_active,
+            timer=self.phase_timer, eval_mask=mask if fused else None,
+            fused_eval=fused, lr=lr_vec)
+
+    def run(self, pivot_metric: str, pivot_mode: str = "max") -> Dict[str, Any]:
+        cfg = self.cfg
+        E = self.arms_spec.count
+        tag = self._arms_tag()
+        blob = resume(cfg["output_dir"], tag, cfg["resume_mode"])
+        if blob and blob.get("data_split") is not None:
+            data_split, label_split = blob["data_split"], blob["label_split"]
+        else:
+            data_split, label_split = self.make_splits()
+        self.stage(data_split, label_split)
+        logger = Logger(os.path.join(cfg["output_dir"], "runs",
+                                     f"train_{tag}"),
+                        use_tensorboard=bool(cfg.get("use_tensorboard")))
+        params = self._init_params()
+        epoch = 1
+        pivots = [(-float("inf") if pivot_mode == "max" else float("inf"))
+                  for _ in range(E)]
+        if blob:
+            params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+            epoch = blob.get("epoch", 1)
+            pivots = blob.get("arm_pivots", pivots)
+            if blob.get("wire_resid") is not None:
+                # the stacked [E, ...] EF carry resumes like a solo run's
+                self._codec_engine().set_wire_resid(blob["wire_resid"])
+            if blob.get("arm_scheds") and self._arm_scheds:
+                for s, st in zip(self._arm_scheds, blob["arm_scheds"]):
+                    s.load_state_dict(st)
+        n_rounds = cfg["num_epochs"]["global"]
+        K = self.superstep_rounds
+        while epoch <= n_rounds:
+            k = min(K, n_rounds - epoch + 1)
+            mask = tuple((epoch + r) % self.eval_interval == 0
+                         or (epoch + r) == n_rounds for r in range(k))
+            t0 = time.time()
+            params, pending = self._dispatch(params, epoch, k, mask)
+            with self.phase_timer.phase("fetch"):
+                out = pending.fetch()
+            dt = time.time() - t0
+            logger.safe(True)
+            evaluated: List[Optional[Dict[str, float]]] = [None] * E
+            for e, arm_out in enumerate(out["arms"]):
+                rounds = arm_out["train"] if isinstance(arm_out, dict) \
+                    else arm_out
+                evals = {ev["epoch"]: ev
+                         for ev in (arm_out.get("eval") or [])} \
+                    if isinstance(arm_out, dict) else {}
+                probes = arm_out.get("obs") \
+                    if isinstance(arm_out, dict) else None
+                for r in range(k):
+                    ms = rounds[r]
+                    if probes:
+                        self._observe_arm(logger, e, epoch + r,
+                                          probes[r], ms)
+                    n = float(np.sum(ms["n"]))
+                    logger.emit(
+                        {"event": "train", "arm": e, "epoch": epoch + r,
+                         "lr": (float(self._staged_lr_vec[e])
+                                if self._plateau
+                                else self._arm_lr(e, epoch + r)),
+                         "loss": (float(np.sum(ms["loss_sum"])) / n
+                                  if n > 0 else None),
+                         "n": n, "dt": dt / (k * E)}, tag="arms")
+                    ev = evals.get(epoch + r)
+                    if ev is not None:
+                        g = summarize_sums(
+                            {kk: np.asarray(v)
+                             for kk, v in ev["global"].items()},
+                            cfg["model_name"], prefix="Global-")
+                        logger.emit({"event": "eval", "arm": e,
+                                     "epoch": epoch + r,
+                                     **{kk: float(vv)
+                                        for kk, vv in g.items()}},
+                                    tag="arms")
+                        evaluated[e] = g
+                        if self._plateau:
+                            # per-arm Plateau: min-mode on this ARM's own
+                            # test Global loss (the solo loop's feed)
+                            self._arm_scheds[e].step_metric(
+                                g.get("Global-Loss", 0.0))
+            epoch_end = epoch + k - 1
+            for e in range(E):
+                g = evaluated[e]
+                cur = g.get(pivot_metric) if g else None
+                is_best = cur is not None and \
+                    (cur > pivots[e] if pivot_mode == "max"
+                     else cur < pivots[e])
+                if is_best:
+                    pivots[e] = cur
+                # per-arm exportable checkpoint: arm e's params slice +
+                # stream identity, loadable by any solo consumer
+                arm_blob = {
+                    "cfg": {kk: v for kk, v in cfg.items() if kk != "vocab"},
+                    "arm": e, "arm_seed": self.arms_spec.seeds[e],
+                    "lr_scale": self.arms_spec.lr_scales[e],
+                    "epoch": epoch_end + 1,
+                    "params": {kk: np.asarray(v[e])
+                               for kk, v in params.items()},
+                    "pivot": pivots[e],
+                }
+                if jax.process_index() == 0:
+                    save_checkpoint(
+                        checkpoint_path(cfg["output_dir"], self._arm_tag(e)),
+                        arm_blob)
+                    if is_best:
+                        copy_best(cfg["output_dir"], self._arm_tag(e))
+            # the multiplexed resume blob: stacked params + per-arm state
+            blob_out = {
+                "cfg": {kk: v for kk, v in cfg.items() if kk != "vocab"},
+                "epoch": epoch_end + 1,
+                "data_split": data_split, "label_split": label_split,
+                "params": params, "arm_pivots": pivots,
+                "wire_resid": (self._codec_engine().wire_resid_host()
+                               if self.wire_codec != "dense" else None),
+                "arm_scheds": ([s.state_dict() for s in self._arm_scheds]
+                               if self._arm_scheds else None),
+            }
+            if jax.process_index() == 0:
+                save_checkpoint(checkpoint_path(cfg["output_dir"], tag),
+                                blob_out)
+            logger.safe(False)
+            epoch = epoch_end + 1
+        return {"params": params, "arms": self.arms_spec, "pivots": pivots,
+                "data_split": data_split, "label_split": label_split}
 
 
 def run_main(description: str, model_default: str, data_default: str,

@@ -85,6 +85,26 @@ def validate_width_geometry(model: ModelDef, cfg: Dict[str, Any]) -> None:
 
 ROUND_RATE_SALT = 7
 USER_SAMPLE_SALT = 11
+#: PRNG salt of the per-arm stream derivation (ISSUE 14): disjoint from
+#: the rate/user salts above and from the engines' 13/98 client streams
+ARM_STREAM_SALT = 17
+
+
+def arm_stream_keys(base_key: jax.Array, seeds) -> jax.Array:
+    """Stacked ``[E]`` per-arm base keys: THE one definition of the arms
+    stream derivation (ISSUE 14, :mod:`~..multi`).
+
+    Arm ``e`` with seed ``s`` owns the stream ``fold_in(fold_in(base_key,
+    ARM_STREAM_SALT), s)``; a ``None`` seed is the IDENTITY arm -- it
+    consumes ``base_key`` itself, which is what makes an ``arms=1`` run
+    bit-identical to the unbatched program (the equivalence contract in
+    tests/test_arms.py).  Engines consume these as the per-round key roots
+    of each arm's round cores (cohort draw, dynamic rates, client/slot
+    keys, deadline budgets, failure draws); the batched program and a solo
+    run with the same seed therefore replay the identical streams."""
+    salted = jax.random.fold_in(base_key, ARM_STREAM_SALT)
+    return jnp.stack([base_key if s is None
+                      else jax.random.fold_in(salted, s) for s in seeds])
 
 
 def round_rates(round_key: jax.Array, cfg: Dict[str, Any],

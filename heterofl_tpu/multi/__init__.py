@@ -1,0 +1,162 @@
+"""Experiment arms multiplexer (ISSUE 14 tentpole): E sweep arms fused
+into ONE superstep program.
+
+The reference's top layer (``make.py``) launches sweep grids as separate
+processes -- one compile, one dispatch, one under-filled mesh per arm.
+This package batches E **experiment arms** into a single fused K-round
+superstep: the engines vmap the scan step over a leading ``[E]`` arms
+axis, so one XLA program trains E trajectories per dispatch, the batched
+counted-average reduction stays EXACTLY one global psum bind per fused
+round (a vmapped pytree psum is still one bind; wire bytes and FLOPs
+scale linearly in E -- audited by equality in staticcheck's arms
+variants), and the per-round metrics come back stacked ``[E, K, ...]``
+through the one PendingMetrics fetch.
+
+**Trace-compatible vs structural knobs.**  An arm may vary anything that
+enters the compiled program as *data*:
+
+* **seed streams** -- each arm owns a PRNG stream derived by
+  ``fold_in(base_key, seed)`` (:func:`~..fed.core.arm_stream_keys`);
+  under the masked engine's in-jit draw each arm samples its own cohort,
+  rolls its own dynamic rates, its own deadline budgets and failure
+  draws from that stream (``seed=None`` is the identity arm: it consumes
+  the base stream itself, which is what makes ``arms=1`` bit-identical
+  to the unbatched program);
+* **LR schedules** -- per-arm multiplicative scales over the shared
+  schedule *shape* (``lr_scales``), or per-arm staged LR scalars under
+  ReduceLROnPlateau (each arm steps its own plateau state at superstep
+  boundaries).
+
+Everything that keys program *structure* -- engine/strategy, placement,
+codec choice, schedule kind, K, the model -- stays per-program: a sweep
+over a structural knob is a separate launch (:mod:`.sweep` partitions a
+grid into trace-compatible arm batches x structural launches).
+Unsupported combinations refuse loudly instead of silently degrading:
+the sliced strategy, per-level codec maps, buffered-async aggregation,
+the streaming client store and grouped-slices placement have carries or
+host bookkeeping that do not batch yet (ROADMAP follow-ons).
+
+This module is import-light (no jax): :func:`resolve_arms_cfg` is THE
+one validator of ``cfg['arms']`` (the ``sched``/``obs`` convention --
+``config.process_control`` applies it and the engines re-apply it); the
+jax half (per-arm key derivation) lives in ``fed/core.py`` next to the
+other stream definitions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+#: hard ceiling on arms per program: the arms axis multiplies program
+#: FLOPs, wire bytes and the params/metrics footprint linearly, and a
+#: fatter batch than this is better served by a second structural launch
+MAX_ARMS = 64
+
+
+class ArmsSpec:
+    """Resolved arms configuration (one immutable object, the
+    ScheduleSpec convention).
+
+    ``count``: E >= 1.  ``seeds``: per-arm stream seeds -- ints folded
+    into the superstep base key, or ``None`` for the identity arm that
+    consumes the base stream itself (the ``arms=1`` default, which is
+    what the E=1 == unbatched bitwise contract rides on).  ``lr_scales``:
+    per-arm multiplicative factors over the shared LR schedule."""
+
+    def __init__(self, count: int, seeds: Tuple[Optional[int], ...],
+                 lr_scales: Tuple[float, ...]):
+        self.count = int(count)
+        self.seeds = tuple(seeds)
+        self.lr_scales = tuple(float(s) for s in lr_scales)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"ArmsSpec(count={self.count}, seeds={self.seeds}, "
+                f"lr_scales={self.lr_scales})")
+
+    def __eq__(self, other):
+        return (isinstance(other, ArmsSpec) and self.count == other.count
+                and self.seeds == other.seeds
+                and self.lr_scales == other.lr_scales)
+
+    def __hash__(self):
+        return hash((self.count, self.seeds, self.lr_scales))
+
+    def solo(self, i: int) -> "ArmsSpec":
+        """The single-arm spec of arm ``i``: the solo run the arm-vs-solo
+        equivalence contract compares against."""
+        return ArmsSpec(1, (self.seeds[i],), (self.lr_scales[i],))
+
+
+def default_seeds(count: int) -> Tuple[Optional[int], ...]:
+    """Default per-arm stream seeds: arm 0 is the identity arm (the base
+    stream, ``None``), arms 1..E-1 fold in their index."""
+    return (None,) + tuple(range(1, count))
+
+
+def resolve_arms_cfg(cfg: Dict[str, Any]) -> Optional[ArmsSpec]:
+    """Validate ``cfg['arms']`` and return the :class:`ArmsSpec` (or
+    ``None`` when arms are off).
+
+    THE one validator (the PR 6/8/9 convention): malformed counts, seed
+    or scale vectors fail loudly at config time, never as a silent
+    single-arm fallback mid-run.  Accepted forms::
+
+        "arms": None          # off (default)
+        "arms": 4             # E=4, default seeds (None,1,2,3), unit scales
+        "arms": {"count": 4,
+                 "seeds": [None, 7, 11, 13],     # optional
+                 "lr_scales": [1.0, 0.3, 3.0, 1.0]}  # optional
+
+    Cross-field conflicts (strategy/codec/schedule/store) live in the
+    engines and the drivers, which own those facts -- same split as
+    ``resolve_telemetry_cfg``."""
+    raw = cfg.get("arms")
+    if raw is None:
+        return None
+    if isinstance(raw, bool):
+        raise ValueError(f"Not valid arms: {raw!r} (an int count, a dict, "
+                         f"or None)")
+    if isinstance(raw, int):
+        raw = {"count": raw}
+    if not isinstance(raw, dict):
+        raise ValueError(f"Not valid arms: {raw!r} (an int count, a dict "
+                         f"with count/seeds/lr_scales, or None)")
+    unknown = set(raw) - {"count", "seeds", "lr_scales"}
+    if unknown:
+        raise ValueError(f"Not valid arms keys: {sorted(unknown)} "
+                         f"(count/seeds/lr_scales)")
+    count = raw.get("count")
+    if not isinstance(count, int) or isinstance(count, bool) or count < 1:
+        raise ValueError(f"Not valid arms count: {count!r} (an int >= 1)")
+    if count > MAX_ARMS:
+        raise ValueError(f"Not valid arms count: {count} exceeds MAX_ARMS="
+                         f"{MAX_ARMS}; split the sweep into several "
+                         f"structural launches (multi.sweep does)")
+    seeds = raw.get("seeds")
+    if seeds is None:
+        seeds = default_seeds(count)
+    else:
+        seeds = tuple(seeds)
+        if len(seeds) != count:
+            raise ValueError(f"Not valid arms seeds: {len(seeds)} entries "
+                             f"for count={count} (one per arm)")
+        for s in seeds:
+            if s is not None and (not isinstance(s, int)
+                                  or isinstance(s, bool) or s < 0):
+                raise ValueError(f"Not valid arm seed: {s!r} (a "
+                                 f"non-negative int, or None for the "
+                                 f"identity arm)")
+    scales = raw.get("lr_scales")
+    if scales is None:
+        scales = (1.0,) * count
+    else:
+        scales = tuple(scales)
+        if len(scales) != count:
+            raise ValueError(f"Not valid arms lr_scales: {len(scales)} "
+                             f"entries for count={count} (one per arm)")
+        for s in scales:
+            if not isinstance(s, (int, float)) or isinstance(s, bool) \
+                    or not s > 0.0:
+                raise ValueError(f"Not valid arm lr_scale: {s!r} (a "
+                                 f"positive number)")
+    return ArmsSpec(count, seeds, scales)

@@ -389,8 +389,16 @@ class FusedEval:
         surrounding superstep graph (measured ~1e-7 relative association
         drift on the CE reductions vs the standalone eval programs), which
         would break the bit-identical-to-host-loop contract."""
-        ev = self._ev
         params, epoch, ops = jax.lax.optimization_barrier((params, epoch, ops))
+        return jax.lax.optimization_barrier(
+            self.core_unfenced(params, epoch, ops))
+
+    def core_unfenced(self, params, epoch, ops) -> Dict[str, Any]:
+        """The eval phase WITHOUT the optimization_barrier fence: the
+        arms-batched supersteps (ISSUE 14) vmap this over the arms axis and
+        fence OUTSIDE the vmap (``optimization_barrier`` has no batching
+        rule) -- same fusion isolation, one fence per eval point."""
+        ev = self._ev
         ukey_root, gkey_root = ops[-2], ops[-1]
         i = 0
         bn: Dict[str, Any] = {}
@@ -405,8 +413,7 @@ class FusedEval:
                                    valid, x, y, m, lm)
         g = ev._global_body(params, bn, jax.random.fold_in(gkey_root, epoch),
                             *ops[i:-2])
-        return jax.lax.optimization_barrier(
-            {"bn": bn, "local": local, "global": g})
+        return {"bn": bn, "local": local, "global": g}
 
     def assemble(self, host_tree, eval_epochs) -> list:
         """Host-side reassembly of the fetched eval stack: one dict per eval

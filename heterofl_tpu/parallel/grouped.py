@@ -64,10 +64,11 @@ from jax.sharding import PartitionSpec as P
 
 from ..compress import make_codec, resid_slots, resolve_codec_cfg
 from ..config import resolve_prefetch_depth
-from ..fed.core import (combine_counted, embed_sliced_jnp, extract_sliced_jnp,
-                        level_flop_table, snap_to_levels)
+from ..fed.core import (arm_stream_keys, combine_counted, embed_sliced_jnp,
+                        extract_sliced_jnp, level_flop_table, snap_to_levels)
 from ..fed.sampling import resolve_sampler_cfg
 from ..models import make_model
+from ..multi import resolve_arms_cfg
 from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
 from ..obs import resolve_telemetry_cfg, split_probes
@@ -142,8 +143,12 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         # per-level codec selection (ISSUE 9 satellite): a {rate: codec}
         # map compresses each level's SLICED partial under its own codec in
         # the one fused-superstep psum bind -- level-a int8 / level-e dense
-        # and friends.  Span layout only: the slices layout's lax.switch
-        # would need every branch to emit every level's payload structure.
+        # and friends.  Works on BOTH level placements (ISSUE 14 satellite
+        # retired the PR 9 slices refusal): under 'slices' every switch
+        # branch emits every level's payload structure -- its own encoded
+        # partial plus the other levels' identity payloads
+        # (codec.zero_payload), with each level's codec counting its own
+        # slice rows as participants.
         self._codec_map = None
         if isinstance(self._codec_name, dict):
             level_set = {float(r) for r in self.levels}  # staticcheck: allow(no-float-coercion): constructor-time config parse
@@ -154,12 +159,6 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                     f"not match the engine's level table "
                     f"{sorted(level_set)}: every level needs exactly one "
                     f"codec")
-            if self.level_placement == "slices":
-                raise ValueError(
-                    "a per-level wire_codec map needs level_placement="
-                    "'span': under 'slices' each device row runs one "
-                    "level's switch branch, which cannot emit the other "
-                    "levels' payload structures")
             self._codec_map = self._codec_name
             self._codec_name = "per-level"  # truthy sentinel; never a codec
         self._map_lay = None  # cached per-level FlatSpec layout
@@ -192,6 +191,51 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         # parse (the probe level table, a trace-time constant)
         self._obs_levels = sorted({float(r) for r in cfg["model_rate"]},
                                   reverse=True)
+        # experiment arms multiplexer (ISSUE 14, heterofl_tpu/multi/): the
+        # grouped engine batches arms over its SPAN fused superstep --
+        # shared host user/rate schedules (level membership is slot
+        # bookkeeping, one layout for all arms), per-arm streams for the
+        # client/slot keys, deadline budgets and failure draws.  Carries
+        # and layouts that do not batch yet refuse loudly here.
+        self._arms_spec = resolve_arms_cfg(cfg)
+        if "arms" in getattr(mesh, "axis_names", ()):
+            raise ValueError(
+                "the grouped engine does not take an 'arms' mesh axis "
+                "yet: its level slot layouts assume the whole clients "
+                "axis (a ROADMAP follow-on) -- use the masked engine for "
+                "mesh-placed arms, or grouped arms under the vmap "
+                "placement")
+        if self._arms_spec is not None:
+            if self._codec_name != "dense":
+                raise ValueError(
+                    "arms with the grouped strategy need the dense wire "
+                    "codec: the grouped EF-residual carry (single-codec "
+                    "and per-level maps alike) does not batch over the "
+                    "arms axis yet (a ROADMAP follow-on); batch dense "
+                    "grouped arms or use the masked engine for codec arms")
+            if self._sched_spec.buffered:
+                raise ValueError(
+                    "arms cannot combine with schedule aggregation="
+                    "'buffered' yet: the staleness buffer is a replicated "
+                    "carry with its own donation/checkpoint contract -- "
+                    "batch dense-sync arms or run buffered solo")
+            if self._obs_on:
+                raise ValueError(
+                    "arms with the grouped strategy need telemetry='off': "
+                    "the span probe rows do not carry the arms axis yet "
+                    "(a ROADMAP follow-on); the masked engine supports "
+                    "telemetry x arms")
+            if self.level_placement == "slices":
+                raise ValueError(
+                    "arms need level_placement='span': the slices layout "
+                    "dispatches each level to its own device rows, and "
+                    "the arms axis would have to batch across disjoint "
+                    "sub-meshes (a ROADMAP follow-on)")
+            if cfg.get("client_store", "eager") == "stream":
+                raise ValueError(
+                    "arms need client_store='eager': the streaming cohort "
+                    "pipeline stages ONE schedule's shards per superstep "
+                    "(a ROADMAP follow-on)")
         if self.level_placement == "slices":
             if jax.process_count() > 1:
                 # slice boundaries are not host-aligned yet: a level whose
@@ -281,15 +325,21 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         self._map_lay = (shapes_key, lay)
         return lay
 
-    def _map_codec(self, rate: float, spec_l: FlatSpec):
+    def _map_codec(self, rate: float, spec_l: FlatSpec,
+                   participants: Optional[int] = None):
         """The (cached) codec object of one lossy level in the per-level
-        map, over that level's sliced flat layout."""
-        key = (float(rate), spec_l.total)  # staticcheck: allow(no-float-coercion): host cache key (rate is a python level)
+        map, over that level's sliced flat layout.  ``participants``: how
+        many devices ENCODE this level's payload -- the whole clients axis
+        under 'span' (default), the level's own slice rows under 'slices'
+        (every other row ships the codec's identity payload, and the
+        decode must attribute lane offsets/scales to the encoders only)."""
+        if participants is None:
+            participants = self.mesh.shape["clients"]
+        key = (float(rate), spec_l.total, int(participants))  # staticcheck: allow(no-float-coercion): host cache key (rate is a python level)
         obj = self._map_codec_objs.get(key)
         if obj is None:
             obj = make_codec(self._codec_map[rate], spec_l,
-                             self.mesh.shape["clients"],
-                             self._error_feedback)
+                             participants, self._error_feedback)
             self._map_codec_objs[key] = obj
         return obj
 
@@ -479,6 +529,11 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         ``async_metrics=True`` the per-slot metric sums stay on device and a
         :class:`~.staging.PendingMetrics` is returned in their place, so the
         caller can overlap the D2H fetch with the next round's dispatch."""
+        if self._arms_spec is not None:
+            raise ValueError(
+                "arms need the fused grouped superstep (train_superstep): "
+                "the K=1 host-orchestrated path dispatches L+1 programs "
+                "per round, which the arms axis would fork per arm")
         if self._codec_name != "dense":
             raise ValueError(
                 f"wire_codec={self._codec_name!r} needs the fused grouped "
@@ -609,7 +664,7 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
 
     def _superstep_prog(self, k: int, per_dev: int, mode: str, eval_mask=None,
                         fused_eval=None, lr_arg: bool = False,
-                        streaming: bool = False):
+                        streaming: bool = False, arms: int = 0):
         """ONE jitted+donated ``shard_map`` program for ``k`` grouped rounds:
         the five per-level programs AND the combine fused into a single XLA
         program, wrapped in a ``lax.scan`` over the rounds (ISSUE 2).
@@ -644,9 +699,10 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         slices: ``[k, slots, ...]``, slot axis sharded over ``clients``);
         each level's core then indexes identity -- program memory is
         O(k x levels x slots), independent of the population."""
-        from .round_engine import eval_fused_scan, superstep_eval_groups
+        from .round_engine import (_ArmsFusedEval, eval_fused_scan,
+                                   superstep_eval_groups)
 
-        key_ = (k, per_dev, mode, eval_mask, lr_arg, streaming)
+        key_ = (k, per_dev, mode, eval_mask, lr_arg, streaming, arms)
         if key_ in self._superstep_progs:
             return self._superstep_progs[key_]
         gm = self.global_model
@@ -658,6 +714,10 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         groups = superstep_eval_groups(eval_mask) if eval_mask else None
         if groups is not None and not any(ev for _, ev, _ in groups):
             groups = None
+        if groups is not None and arms:
+            # arms multiplexer (ISSUE 14): the fused eval phase runs vmapped
+            # over the arms axis against the shared committed operands
+            fused_eval = _ArmsFusedEval(fused_eval, arms)
 
         def embed(tree, rate):
             return embed_sliced_jnp(tree, gm.specs, gm.groups, rate / self.global_rate)
@@ -688,8 +748,14 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
             else:
                 base_key, epoch0, *rest = all_rest
             idx = 0
+            ascales = None
             if lr_arg:
+                # under arms this is the staged PER-ARM LR vector [E]
                 lr_const = rest[0]
+                idx = 1
+            elif arms:
+                # per-arm multiplicative scales over the shared schedule
+                ascales = rest[0]
                 idx = 1
             sched = rest[idx]
             if streaming:
@@ -740,6 +806,43 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                     t, srow, *d = xs
                 else:
                     t, srow = xs
+                if arms:
+                    # arms multiplexer (ISSUE 14): the whole span round --
+                    # every level core, the embeds, the SINGLE global psum
+                    # and the counted-average combine -- vmapped over the
+                    # leading arms axis of the params carry.  The host
+                    # schedule (level-grouped slots) is SHARED across arms
+                    # (level membership is slot bookkeeping, one layout for
+                    # all); per-arm streams drive the client/slot keys,
+                    # deadline budgets and failure draws, so arm e is a
+                    # solo grouped run with seed e on the same schedule,
+                    # bitwise.  The batched psum stays ONE bind; wire
+                    # bytes and FLOPs scale linearly in E (staticcheck
+                    # arms variants audit both by equality).
+                    scales = lr_const if lr_arg else ascales
+
+                    def arm_core(p_e, akey, sc_e):
+                        key_e = jax.random.fold_in(akey, t)
+                        lr_e = sc_e if lr_arg else lr_fn(t) * sc_e
+                        tot_se = tot_ce = None
+                        ms_lv = []
+                        for li, rate in enumerate(level_rates):
+                            s_l, c_l, ms_l = self._level_core(
+                                rate, p_e, key_e, lr_e, srow[li], data,
+                                n_data, data_axis)
+                            s_l, c_l = embed(s_l, rate), embed(c_l, rate)
+                            tot_se = s_l if tot_se is None else \
+                                {n: tot_se[n] + s_l[n] for n in tot_se}
+                            tot_ce = c_l if tot_ce is None else \
+                                {n: tot_ce[n] + c_l[n] for n in tot_ce}
+                            ms_lv.append(ms_l)
+                        ms_e = {n: jnp.stack([m[n] for m in ms_lv])
+                                for n in ms_lv[0]}
+                        tot_se, tot_ce = jax.lax.psum((tot_se, tot_ce),
+                                                      "clients")
+                        return combine_counted(p_e, tot_se, tot_ce), ms_e
+
+                    return jax.vmap(arm_core)(p, base_key, scales)
                 key = jax.random.fold_in(base_key, t)
                 lr = lr_const if lr_arg else lr_fn(t)
                 hist_ts = None
@@ -748,14 +851,104 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                     # (ISSUE 12) -- from the data aval, level-invariant
                     hist_ts = self._hist_total_steps(d[0] if streaming
                                                      else data[0])
+                if per_level and mode == "slices":
+                    # per-level codec map x slices layout (ISSUE 14
+                    # satellite, retiring the PR 9 refusal): each device
+                    # row runs ONLY its level's switch branch, yet every
+                    # branch emits EVERY level's payload structure -- its
+                    # own level's encoded partial plus the other levels'
+                    # IDENTITY payloads (codec.zero_payload, all-zero
+                    # lanes).  Each level's codec counts its slice's rows
+                    # as participants, so the shared decode attributes
+                    # lane bias/scale sums to exactly the rows that
+                    # encoded.  Still ONE global psum bind carrying the
+                    # per-level payload tree -- the same wire budget as
+                    # the span map (fed.core.level_codec_map_byte_table,
+                    # priced by equality in staticcheck).
+                    lay = self._map_layout(p)
+                    row = jax.lax.axis_index("clients")
+                    branch = jnp.sum(row >= level_los) - 1
+                    rows_of = {r_: self._slices[r_][1] - self._slices[r_][0]
+                               for r_ in level_rates}
+
+                    def zero_tree(rate_z):
+                        spec_z = lay["specs"][rate_z]
+                        if self._codec_map[rate_z] == "dense":
+                            return (jnp.zeros(spec_z.total, jnp.float32),
+                                    jnp.zeros(spec_z.total, jnp.float32))
+                        return self._map_codec(
+                            rate_z, spec_z, rows_of[rate_z]).zero_payload()
+
+                    def mk_pl(rate_own):
+                        def f(p_, key_l, lr_l, u_, rs_):
+                            s_l, c_l, ms_l = self._level_core(
+                                rate_own, p_, key_l, lr_l, u_,
+                                tuple(d) if streaming else data, 1, None,
+                                local_data=streaming)
+                            spec_o = lay["specs"][rate_own]
+                            sf, cf = spec_o.flatten(s_l), spec_o.flatten(c_l)
+                            payload = {f"L{lz}": zero_tree(rz)
+                                       for lz, rz in enumerate(level_rates)
+                                       if rz != rate_own}
+                            li_own = level_rates.index(rate_own)
+                            if self._codec_map[rate_own] == "dense":
+                                payload[f"L{li_own}"] = (sf, cf)
+                                nr_own = rs_
+                            else:
+                                cobj = self._map_codec(rate_own, spec_o,
+                                                       rows_of[rate_own])
+                                off = lay["offsets"][rate_own]
+                                rs_l = jax.lax.dynamic_slice(
+                                    rs_, (0, off),
+                                    (2, spec_o.total))[:cobj.resid_slots]
+                                sub_o = extract_sliced_jnp(
+                                    p_, gm.specs, gm.groups,
+                                    rate_own / self.global_rate)
+                                pl, nr_l = cobj.encode(sf, cf, rs_l, sub_o,
+                                                       key_l, per_dev)
+                                payload[f"L{li_own}"] = pl
+                                nr_own = jax.lax.dynamic_update_slice(
+                                    rs_, nr_l, (0, off))
+                            return payload, nr_own, ms_l
+                        return f
+
+                    payload, nr, ms = jax.lax.switch(
+                        branch, [mk_pl(r_) for r_ in level_rates], p, key,
+                        lr, srow, rs)
+                    # THE single global psum: one bind joins every level's
+                    # payload across the whole clients axis
+                    agg = jax.lax.psum(payload, "clients")
+                    tot_s = tot_c = None
+                    for li, rate in enumerate(level_rates):
+                        spec_l = lay["specs"][rate]
+                        if self._codec_map[rate] == "dense":
+                            sf, cf = agg[f"L{li}"]
+                        else:
+                            cobj = self._map_codec(rate, spec_l,
+                                                   rows_of[rate])
+                            sub_l = extract_sliced_jnp(
+                                p, gm.specs, gm.groups,
+                                rate / self.global_rate)
+                            sf, cf = cobj.decode(agg[f"L{li}"], sub_l, key,
+                                                 per_dev)
+                        s_e = embed(spec_l.unflatten(sf), rate)
+                        c_e = embed(spec_l.unflatten(cf), rate)
+                        tot_s = s_e if tot_s is None else \
+                            {n: tot_s[n] + s_e[n] for n in tot_s}
+                        tot_c = c_e if tot_c is None else \
+                            {n: tot_c[n] + c_e[n] for n in tot_c}
+                    new_p = combine_counted(p, tot_s, tot_c)
+                    ms = attach_probes(ms, p, new_p, tot_s, tot_c, nr_=nr,
+                                       uids_=srow, key_=key, ts_=hist_ts)
+                    return (new_p, nr), ms
                 if per_level:
                     # per-level codec selection (ISSUE 9 satellite): each
                     # level's SLICED counted sums join the round's ONE psum
                     # bind under that level's own codec -- dense levels ship
                     # raw f32 at sliced shape, lossy levels their packed
                     # lanes, and the EF residuals of the lossy levels
-                    # concatenate into one [2, total_lossy] carry.  Span
-                    # layout only (validated at construction).
+                    # concatenate into one [2, total_lossy] carry (span
+                    # layout; the slices layout branches above).
                     lay = self._map_layout(p)
                     payload, ms_levels, dec = {}, [], {}
                     for li, rate in enumerate(level_rates):
@@ -900,7 +1093,7 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
             p_out, extra = unpack(carry)
             return (p_out,) + extra + (ms, ev)
 
-        lr_specs = (P(),) if lr_arg else ()
+        lr_specs = (P(),) if (lr_arg or arms) else ()
         eval_specs = tuple(fused_eval.specs) if groups else ()
         resid_specs = (P("clients"),) if codec else ()
         buf_specs = (P(),) if buffered else ()
@@ -911,7 +1104,12 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
             data_specs = (sched_spec,) * n_data_args
         else:
             data_specs = (P(), P()) if self.is_lm else (P(), P(), P(), P())
-        ms_spec = P(None, None, "clients") if mode == "span" else P(None, "clients")
+        if arms:
+            # [k, E, L, slots]: the arms axis rides behind the round axis
+            ms_spec = P(None, None, None, "clients")
+        else:
+            ms_spec = P(None, None, "clients") if mode == "span" \
+                else P(None, "clients")
         out_specs = (P(),) + carry_specs + (ms_spec,)
         if groups is not None:
             out_specs = out_specs + (fused_eval.out_specs,)
@@ -929,9 +1127,15 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         # garbage on a stable subset of its elements (fresh compiles are
         # correct; caught by test_resid_checkpoint_roundtrip_grouped on a
         # warm cache).  Cost: one extra params-size buffer per dispatch,
-        # priced into the staticcheck HBM budgets.
-        prog = jax.jit(fn, donate_argnums=(1,) if (codec or buffered)
-                       else (0,))
+        # priced into the staticcheck HBM budgets.  Arms programs (ISSUE
+        # 14) donate NOTHING: the same bug class intermittently corrupts
+        # the E-stacked params carry on deserialized executables (see
+        # round_engine._build_superstep).
+        if arms:
+            donate = ()
+        else:
+            donate = (1,) if (codec or buffered) else (0,)
+        prog = jax.jit(fn, donate_argnums=donate)
         self._superstep_progs[key_] = prog
         return prog
 
@@ -1086,7 +1290,14 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         if not lr_arg and self._lr_fn is None:
             self._lr_fn = make_traced_lr_fn(self.cfg)
         timer = timer if timer is not None else PhaseTimer()
+        aspec = self._arms_spec
+        arms = aspec.count if aspec is not None else 0
         if cohort is not None:
+            if aspec is not None:
+                raise ValueError(
+                    "arms need the eager data path: a staged cohort holds "
+                    "ONE schedule's shards, and per-arm cohorts would "
+                    "multiply the staged bytes by E (a ROADMAP follow-on)")
             if cohort.engine != "grouped" or cohort.k != k:
                 raise ValueError(
                     f"cohort mismatch: staged for engine={cohort.engine!r} "
@@ -1132,18 +1343,36 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                 spec = P(None, None, "clients") if mode == "span" \
                     else P(None, "clients")
                 sched_dev = self._staging.put(sched, spec=spec)
-                lr_args = (self._staging.scalar(lr),) if lr_arg else ()
+                if lr_arg:
+                    # arms: the per-arm LR vector [E] (Plateau steps each
+                    # arm's own state); solo: a scalar
+                    lr_args = ((self._staging.put(
+                        np.asarray(lr, np.float32).reshape(arms)),) if arms  # staticcheck: allow(no-asarray): host LR-vector normalization; reaches the mesh via the explicit staging.put
+                        else (self._staging.scalar(lr),))
+                elif arms:
+                    # per-arm multiplicative scales over the shared schedule
+                    lr_args = (self._staging.put(
+                        np.asarray(aspec.lr_scales, np.float32)),)  # staticcheck: allow(no-asarray): host scale-vector normalization; reaches the mesh via the explicit staging.put
+                else:
+                    lr_args = ()
                 eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
                 epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
                 # commit the params carry (see train_round), layout pinned
                 global_params = self._staging.commit(self._pin(global_params))
                 carry_args = self._carry_args(global_params)
+                if arms and mode != "span":  # pragma: no cover - slices
+                    raise ValueError(  # refused at construction already
+                        "arms need level_placement='span'")
                 prog = self._superstep_prog(k, per_dev, mode,
                                             eval_mask=eval_mask,
                                             fused_eval=fused_eval,
-                                            lr_arg=lr_arg)
+                                            lr_arg=lr_arg, arms=arms)
+        # arms (ISSUE 14): the program takes the stacked [E] per-arm key
+        # roots in the base-key slot (fed.core.arm_stream_keys)
+        dispatch_key = arm_stream_keys(base_key, aspec.seeds) \
+            if aspec is not None else base_key
         with timer.phase("dispatch"):
-            out = prog(global_params, *carry_args, base_key, epoch0_dev,
+            out = prog(global_params, *carry_args, dispatch_key, epoch0_dev,
                        *lr_args, sched_dev, *args, *eval_args)
         if self._codec_name != "dense":
             # stash the new error-feedback carry (checkpointed via
@@ -1186,6 +1415,12 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
             new_params, ms = out
 
             def _assemble(host):
+                if arms:
+                    # [k, E, L, slots] -> per-arm [k, L, slots], then the
+                    # solo reassembly (ISSUE 14; probes refused with arms)
+                    return {"arms": [
+                        _assemble_train({n: v[:, e] for n, v in host.items()})
+                        for e in range(arms)]}
                 host, probes = _split(host)
                 rounds = _assemble_train(host)
                 if probes is not None:
@@ -1199,6 +1434,14 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
 
         def _assemble_eval(host):
             ms_h, ev_h = host
+            if arms:
+                return {"arms": [
+                    {"train": _assemble_train({n: v[:, e]
+                                               for n, v in ms_h.items()}),
+                     "eval": fused_eval.assemble(
+                         jax.tree_util.tree_map(lambda v: v[:, e], ev_h),
+                         eval_epochs)}
+                    for e in range(arms)]}
             ms_h, probes = _split(ms_h)
             out_d = {"train": _assemble_train(ms_h),
                      "eval": fused_eval.assemble(ev_h, eval_epochs)}

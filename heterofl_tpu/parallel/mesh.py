@@ -18,20 +18,31 @@ from jax.sharding import Mesh
 
 
 def make_mesh(n_clients: Optional[int] = None, n_data: int = 1,
-              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a ``(clients, data)`` mesh.
+              devices: Optional[Sequence[jax.Device]] = None,
+              n_arms: int = 1) -> Mesh:
+    """Build a ``(clients, data)`` mesh -- or ``(arms, clients, data)``
+    with ``n_arms > 1`` (ISSUE 14: the ``experiments`` mesh dimension).
 
-    ``n_clients=None`` uses all devices (divided by ``n_data``).  On a single
-    chip this degenerates to a 1x1 mesh and the collectives become no-ops --
-    same program, any scale.
+    ``n_clients=None`` uses all devices (divided by ``n_data`` and
+    ``n_arms``).  On a single chip this degenerates to a 1x1 mesh and the
+    collectives become no-ops -- same program, any scale.  The arms axis
+    places each experiment arm's whole federation on its own disjoint
+    device rows: the per-arm ``psum`` over ``clients`` reduces within an
+    arm's rows only, so E arms execute CONCURRENTLY on a mesh a single
+    arm cannot fill (the engines' ``arms_placement='mesh'``).
     """
     devices = list(devices if devices is not None else jax.devices())
+    n_arms = max(1, int(n_arms))
     if n_clients is None:
-        assert len(devices) % n_data == 0, "device count not divisible by data axis"
-        n_clients = len(devices) // n_data
-    need = n_clients * n_data
+        assert len(devices) % (n_data * n_arms) == 0, \
+            "device count not divisible by data x arms axes"
+        n_clients = len(devices) // (n_data * n_arms)
+    need = n_clients * n_data * n_arms
     if need > len(devices):
         raise ValueError(f"need {need} devices, have {len(devices)}")
+    if n_arms > 1:
+        arr = np.array(devices[:need]).reshape(n_arms, n_clients, n_data)
+        return Mesh(arr, ("arms", "clients", "data"))
     arr = np.array(devices[:need]).reshape(n_clients, n_data)
     return Mesh(arr, ("clients", "data"))
 

@@ -37,11 +37,13 @@ except ImportError:  # pragma: no cover
 
 from ..compress import make_codec, resid_slots, resolve_codec_cfg
 from ..config import resolve_prefetch_depth
+from ..multi import resolve_arms_cfg
 from ..obs import resolve_telemetry_cfg, split_probes
 from ..obs.hist import round_hists
 from ..obs.probes import round_probes
 from ..data.datasets import DATASET_STATS
-from ..fed.core import combine_counted, round_rates, round_users
+from ..fed.core import (arm_stream_keys, combine_counted, round_rates,
+                        round_users)
 from ..fed.sampling import resolve_sampler_cfg
 from ..sched import resolve_schedule_cfg
 from ..sched.buffer import _SchedBufCarry, buffered_combine
@@ -147,6 +149,42 @@ def normalize_eval_mask(eval_mask, k: int, fused_eval):
     return eval_mask
 
 
+class _ArmsFusedEval:
+    """:class:`~.evaluation.FusedEval` adapter for arms-batched supersteps
+    (ISSUE 14): ``core`` runs the inner eval phase vmapped over the leading
+    arms axis of the params stack against the SHARED once-committed eval
+    operands, so each arm's sBN recalibration + Local/Global eval is the
+    solo core's computation on that arm's params; ``out_specs`` grow the
+    arms axis behind the eval-stack axis.  Host-side assembly stays the
+    inner object's (the engines slice each arm out before assembling)."""
+
+    def __init__(self, inner, count: int, axis=None):
+        self._inner = inner
+        self.count = count
+        self.axis = axis  # 'arms' under the mesh placement, else None
+        self.ops = inner.ops
+        self.specs = inner.specs
+
+    @property
+    def out_specs(self):
+        # [n_evals, E, ...]: bn moments and Global sums replicated within
+        # an arm (sharded over the arms axis under the mesh placement),
+        # the per-user Local sums sharded over clients behind (evals, arms)
+        return {"bn": P(None, self.axis),
+                "local": P(None, self.axis, "clients"),
+                "global": P(None, self.axis)}
+
+    def core(self, params, epoch, ops):
+        # the fence sits OUTSIDE the vmap (optimization_barrier has no
+        # batching rule): same fusion isolation as the solo core, one
+        # fence per eval point
+        params, epoch, ops = jax.lax.optimization_barrier(
+            (params, epoch, ops))
+        out = jax.vmap(
+            lambda p: self._inner.core_unfenced(p, epoch, ops))(params)
+        return jax.lax.optimization_barrier(out)
+
+
 def superstep_eval_groups(mask):
     """Compress a static per-round eval mask into ``[(n, do_eval, repeat)]``
     scan groups: ``n`` training rounds followed (``do_eval``) by one fused
@@ -236,9 +274,32 @@ class _WireCodecCarry:
                                          self._error_feedback)
         return self._codec_obj
 
-    def _resid_shape(self, params) -> Tuple[int, int, int]:
-        return (self.mesh.shape["clients"], resid_slots(self._codec_name),
-                FlatSpec.of(params).total)
+    def _arms_count(self) -> int:
+        """E when this engine multiplexes experiment arms (ISSUE 14), else
+        0: the EF residual grows a leading arms axis (even at E=1 -- the
+        arms programs always carry it) -- each arm owns its own
+        compression-error stream, exactly like a solo run's."""
+        spec = getattr(self, "_arms_spec", None)
+        return spec.count if spec is not None else 0
+
+    def _resid_pspec(self):
+        """The residual carry's PartitionSpec: per-device rows over the
+        clients axis, behind the arms axis when arms are on (the arms
+        axis itself is sharded under the mesh placement)."""
+        if not self._arms_count():
+            return P("clients")
+        return P("arms", "clients") if getattr(self, "_arms_mesh", False) \
+            else P(None, "clients")
+
+    def _resid_shape(self, params) -> Tuple[int, ...]:
+        e = self._arms_count()
+        # under arms the params tree arrives STACKED [E, ...]: the flat
+        # layout (and so the residual's trailing dim) is per arm
+        shapes = {k: (tuple(v.shape[1:]) if e else tuple(v.shape))
+                  for k, v in params.items()}
+        base = (self.mesh.shape["clients"], resid_slots(self._codec_name),
+                FlatSpec(shapes).total)
+        return ((e,) + base) if e else base
 
     def _ensure_resid(self, params):
         """The committed error-feedback carry (zeros on first use): built by
@@ -248,7 +309,7 @@ class _WireCodecCarry:
 
         shape = self._resid_shape(params)
         if self._resid is None or tuple(self._resid.shape) != shape:
-            sh = NamedSharding(self.mesh, P("clients"))
+            sh = NamedSharding(self.mesh, self._resid_pspec())
             # staticcheck: allow(jit-needs-donation): one-time zeros init
             # (nothing to donate); steady-state rounds donate the carry
             self._resid = jax.jit(
@@ -269,7 +330,7 @@ class _WireCodecCarry:
         through a jitted copy so the restored buffer is donation-safe."""
         from jax.sharding import NamedSharding
 
-        sh = NamedSharding(self.mesh, P("clients"))
+        sh = NamedSharding(self.mesh, self._resid_pspec())
         # staticcheck: allow(no-asarray): checkpoint-restore host
         # normalization; the carry reaches the mesh via the explicit
         # device_put + jitted private copy below
@@ -381,6 +442,45 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                 "schedule aggregation='buffered' cannot combine with a "
                 "lossy wire_codec yet: both add a scan carry with its own "
                 "donation/checkpoint contract -- pick one per experiment")
+        # experiment arms multiplexer (ISSUE 14, heterofl_tpu/multi/): E
+        # trace-compatible sweep arms vmapped over a leading axis of the
+        # fused superstep -- structural for THIS engine instance (the arms
+        # count keys every program), resolved once here.  None = single
+        # trajectory, every program byte-identical to pre-arms.
+        self._arms_spec = resolve_arms_cfg(cfg)
+        if self._arms_spec is not None:
+            if self._sched_spec.buffered:
+                raise ValueError(
+                    "arms cannot combine with schedule aggregation="
+                    "'buffered' yet: the staleness buffer is a replicated "
+                    "carry with its own donation/checkpoint contract -- "
+                    "batch dense-sync arms or run buffered solo")
+            if cfg.get("client_store", "eager") == "stream":
+                raise ValueError(
+                    "arms need client_store='eager': the streaming cohort "
+                    "pipeline stages ONE schedule's shards per superstep, "
+                    "and per-arm cohorts would multiply the staged bytes "
+                    "by E (a ROADMAP follow-on)")
+        # arms placement (ISSUE 14): the stacked arms axis is either
+        # vmap-batched on every device (the default -- E x per-device
+        # work, one dispatch) or laid over a dedicated 'arms' MESH axis
+        # (make_mesh(n_arms=E)): each arm's whole federation lives on its
+        # own device rows, the per-arm psum reduces within them, and E
+        # arms execute CONCURRENTLY -- the mesh-filling layout for a pod
+        # (or CPU core pool) a single arm cannot fill.
+        self._arms_mesh = mesh is not None and "arms" in mesh.axis_names
+        if self._arms_mesh:
+            if self._arms_spec is None:
+                raise ValueError(
+                    "mesh has an 'arms' axis but cfg['arms'] is off: a "
+                    "solo program on an arms mesh would silently train an "
+                    "independent replica per arm row -- drop the axis or "
+                    "set cfg['arms']")
+            if mesh.shape["arms"] != self._arms_spec.count:
+                raise ValueError(
+                    f"mesh arms axis size ({mesh.shape['arms']}) must "
+                    f"equal the arms count ({self._arms_spec.count}): one "
+                    f"device row group per arm")
         self._train = None
         self._superstep_progs: Dict[Tuple, Any] = {}
         self._lr_fn = None  # built on first superstep (plateau raises there)
@@ -917,7 +1017,8 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
 
     def _build_superstep(self, k: int, per_dev: int, in_jit: bool,
                          num_active: int = 0, eval_mask=None, fused_eval=None,
-                         lr_arg: bool = False, streaming: bool = False):
+                         lr_arg: bool = False, streaming: bool = False,
+                         arms: int = 0):
         """One jitted+donated program for ``k`` federated rounds: the round
         boundary leaves the host (ISSUE 2 tentpole).
 
@@ -971,6 +1072,12 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             groups = None  # an all-False mask is the plain train superstep
         codec = self._codec_name != "dense"
         buffered = self._sched_spec.buffered
+        arms_axis = "arms" if (arms and self._arms_mesh) else None
+        if groups is not None and arms:
+            # arms multiplexer (ISSUE 14): the fused eval phase runs vmapped
+            # over the (local) arms axis against the shared committed
+            # operands -- one arm per device row under the mesh placement
+            fused_eval = _ArmsFusedEval(fused_eval, arms, axis=arms_axis)
         # in-jit availability sampling (ISSUE 9): only the eager replicated
         # path samples inside the scan -- a non-uniform schedule threads its
         # [T, U] trace in as a replicated program argument there; every
@@ -993,8 +1100,14 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             if trace_arg:
                 trace = rest[0]
                 idx = 1
+            ascales = None
             if lr_arg:
+                # under arms this is the staged PER-ARM LR vector [E]
                 lr_const = rest[idx]
+                idx += 1
+            elif arms:
+                # per-arm multiplicative scales over the shared schedule
+                ascales = rest[idx]
                 idx += 1
             if streaming:
                 sched_ug = rest[idx]
@@ -1025,6 +1138,63 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                     if buffered:
                         return (new_p, nb)
                     return new_p
+
+                if arms:
+                    # arms multiplexer (ISSUE 14): one round of E arms --
+                    # the round core vmapped over the leading arms axis of
+                    # the params carry (and EF residual), each arm keyed by
+                    # its own stream root (base_key here is the stacked
+                    # [E] arm keys) with the population stacks SHARED.
+                    # The vmapped psum inside _round_core stays EXACTLY
+                    # one bind per fused round (a batched pytree psum);
+                    # wire bytes scale linearly in E (staticcheck arms
+                    # variants audit both by equality).  In-jit sampling
+                    # draws each arm its OWN cohort from its stream -- a
+                    # solo run with the same seed replays it bitwise;
+                    # host-schedule paths share the packed slots.
+                    if in_jit:
+                        (t,) = xs
+                        ul_s = ug_s = None
+                    else:
+                        t, ul_s, ug_s = xs
+                    scales = lr_const if lr_arg else ascales
+
+                    def arm_core(p_e, akey, sc_e, rs_e):
+                        key = jax.random.fold_in(akey, t)
+                        lr = sc_e if lr_arg else lr_fn(t) * sc_e
+                        if in_jit:
+                            if trace_arg:
+                                row = jnp.take(trace,
+                                               (t - 1) % trace.shape[0],
+                                               axis=0)
+                                active = round_users(key, num_users,
+                                                     num_active, avail=row,
+                                                     sampler=sampler)
+                            else:
+                                active = round_users(key, num_users,
+                                                     num_active,
+                                                     sampler=sampler)
+                            padv = jnp.full((slots_total - num_active,),
+                                            -1, jnp.int32)
+                            padded = jnp.concatenate([active, padv])
+                            d = jax.lax.axis_index("clients")
+                            ug_e = jax.lax.dynamic_slice(
+                                padded, (d * per_dev,), (per_dev,))
+                            ul_e = ug_e
+                        else:
+                            ul_e, ug_e = ul_s, ug_s
+                        new_p, ms, nr, _ = self._round_core(
+                            p_e, key, lr, ul_e, ug_e, data, resid=rs_e)
+                        return new_p, ms, nr
+
+                    if codec:
+                        new_p, ms, nr = jax.vmap(arm_core)(
+                            p, base_key, scales, rs)
+                    else:
+                        new_p, ms, nr = jax.vmap(
+                            arm_core, in_axes=(0, 0, 0, None))(
+                            p, base_key, scales, None)
+                    return pack(new_p, nr, None), ms
 
                 if streaming:
                     t, ug, *d = xs
@@ -1070,7 +1240,9 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             else:
                 xs = (epochs,) if in_jit else (epochs, sched_ul, sched_ug)
             if codec:
-                carry0 = (params, resid0[0])
+                # arms: the per-device residual arrives [E, 1, slots,
+                # total] -- drop the device axis behind the arms axis
+                carry0 = (params, resid0[:, 0] if arms else resid0[0])
             elif buffered:
                 carry0 = (params, buf0)
             else:
@@ -1078,7 +1250,8 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
 
             def unpack(carry):
                 if codec:
-                    return carry[0], (carry[1][None],)
+                    return carry[0], ((carry[1][:, None] if arms
+                                       else carry[1][None]),)
                 if buffered:
                     return carry[0], (carry[1],)
                 return carry, ()
@@ -1093,28 +1266,46 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             p_out, extra = unpack(carry)
             return (p_out,) + extra + (ms, ev)
 
-        lr_specs = (P(),) if lr_arg else ()
+        # under the mesh placement the stacked [E] leaves -- params carry,
+        # arm keys, LR scales, metrics -- shard over the 'arms' axis (one
+        # arm per device row group); under vmap they replicate
+        arm_lead = P(arms_axis)
+        lr_specs = (arm_lead if arms else P(),) if (lr_arg or arms) else ()
         trace_specs = (P(),) if trace_arg else ()
         eval_specs = tuple(fused_eval.specs) if groups else ()
-        resid_specs = (P("clients"),) if codec else ()
+        resid_specs = (self._resid_pspec(),) if codec else ()
         buf_specs = (P(),) if buffered else ()
         carry_specs = resid_specs + buf_specs  # mutually exclusive
-        out_specs = (P(),) + carry_specs + (P(None, "clients"),)
+        ms_spec = P(None, arms_axis, "clients") if arms \
+            else P(None, "clients")
+        params_spec = arm_lead if arms else P()
+        key_spec = arm_lead if arms else P()
+        out_specs = (params_spec,) + carry_specs + (ms_spec,)
         if groups is not None:
             out_specs = out_specs + (fused_eval.out_specs,)
         fn = _shard_map(
             sbody, mesh,
-            in_specs=(P(),) + carry_specs + (P(), P()) + trace_specs
-            + lr_specs + sched_specs + data_specs + eval_specs,
+            in_specs=(params_spec,) + carry_specs + (key_spec, P())
+            + trace_specs + lr_specs + sched_specs + data_specs
+            + eval_specs,
             out_specs=out_specs,
         )
         # codec/buffered programs donate ONLY their extra carry (see
         # _WireCodecCarry: params donation + a params-sized extra output
         # trips an XLA:CPU executable-serialization bug when reloaded from
         # the persistent compile cache; caught by the masked signsgd
-        # checkpoint round-trip on a warm cache)
-        return jax.jit(fn, donate_argnums=(1,) if (codec or buffered)
-                       else (0,))
+        # checkpoint round-trip on a warm cache).  Arms programs (ISSUE
+        # 14) donate NOTHING when dense: donating the E-stacked params
+        # carry intermittently corrupts single leaves (1e24-magnitude
+        # garbage) when the program is DESERIALIZED from the persistent
+        # cache -- the same upstream XLA:CPU bug class, reproduced on the
+        # multiplexed driver's resume path.  Cost: one extra E x params
+        # buffer per dispatch, priced into the staticcheck arms budgets.
+        if arms:
+            donate = (1,) if (codec or buffered) else ()
+        else:
+            donate = (1,) if (codec or buffered) else (0,)
+        return jax.jit(fn, donate_argnums=donate)
 
     def stage_cohort(self, store: ClientStore, user_schedule,
                      timer: PhaseTimer = None) -> StagedCohort:
@@ -1218,7 +1409,14 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         if not lr_arg and self._lr_fn is None:
             self._lr_fn = make_traced_lr_fn(self.cfg)
         timer = timer if timer is not None else PhaseTimer()
+        aspec = self._arms_spec
+        arms = aspec.count if aspec is not None else 0
         if cohort is not None:
+            if aspec is not None:
+                raise ValueError(
+                    "arms need the eager data path: a staged cohort holds "
+                    "ONE schedule's shards, and per-arm cohorts would "
+                    "multiply the staged bytes by E (a ROADMAP follow-on)")
             if cohort.engine != "masked" or cohort.k != k:
                 raise ValueError(
                     f"cohort mismatch: staged for engine={cohort.engine!r} "
@@ -1310,14 +1508,32 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                 args = self._staging.replicated("train_data", data)
             if self.fix_rates is not None:
                 args = args + self._staging.replicated("fix_rates", (self.fix_rates,))
-            lr_args = (self._staging.scalar(lr),) if lr_arg else ()
+            arm_vec_spec = P("arms") if self._arms_mesh else P()
+            if lr_arg:
+                # arms: the per-arm LR vector [E] (Plateau steps each arm's
+                # own state at superstep boundaries); solo: a scalar
+                lr_args = ((self._staging.put(
+                    np.asarray(lr, np.float32).reshape(arms),  # staticcheck: allow(no-asarray): host LR-vector normalization; reaches the mesh via the explicit staging.put
+                    spec=arm_vec_spec),) if arms
+                    else (self._staging.scalar(lr),))
+            elif arms:
+                # per-arm multiplicative LR scales over the shared schedule
+                lr_args = (self._staging.put(
+                    np.asarray(aspec.lr_scales, np.float32),  # staticcheck: allow(no-asarray): host scale-vector normalization; reaches the mesh via the explicit staging.put
+                    spec=arm_vec_spec),)
+            else:
+                lr_args = ()
             eval_args = tuple(fused_eval.ops) if eval_mask is not None else ()
             epoch0_dev = self._staging.scalar(epoch0, dtype=np.int32)
             # commit the params carry: an uncommitted init tree would
             # specialise this program once and recompile on round 2 when the
             # outputs come back mesh-committed (staticcheck recompile audit);
-            # the layout pin rides the same commit (models/layout.py policy)
-            params = self._staging.commit(self._pin(params))
+            # the layout pin rides the same commit (models/layout.py policy).
+            # Under the mesh arms placement the stacked axis commits sharded
+            # over the 'arms' rows (each arm's params live on its own rows)
+            params = self._staging.commit(
+                self._pin(params),
+                spec=P("arms") if (arms and self._arms_mesh) else P())
             carry_args = self._carry_args(params)
             trace_args = ()
             if in_jit and self._sched_spec.kind != "uniform":
@@ -1327,21 +1543,34 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                 # steady-state identity hit
                 trace_args = self._staging.replicated(
                     "sched_trace", (self._sched_spec.trace,))
-            pkey = (k, per_dev, in_jit, a, eval_mask, lr_arg)
+            # arms (ISSUE 14): the program takes the stacked [E] per-arm
+            # key roots in the base-key slot -- THE one stream derivation
+            # (fed.core.arm_stream_keys), shared with solo runs; the mesh
+            # placement commits them one per arm row group
+            if aspec is not None:
+                dispatch_key = arm_stream_keys(base_key, aspec.seeds)
+                if self._arms_mesh:
+                    dispatch_key = self._staging.put(dispatch_key,
+                                                     spec=P("arms"))
+            else:
+                dispatch_key = base_key
+            pkey = (k, per_dev, in_jit, a, eval_mask, lr_arg, arms,
+                    self._arms_mesh)
             prog = self._superstep_progs.get(pkey)
             if prog is None:
                 prog = self._build_superstep(k, per_dev, in_jit, num_active=a,
                                              eval_mask=eval_mask,
                                              fused_eval=fused_eval,
-                                             lr_arg=lr_arg)
+                                             lr_arg=lr_arg, arms=arms)
                 self._superstep_progs[pkey] = prog
         with timer.phase("dispatch"):
-            out = prog(params, *carry_args, base_key, epoch0_dev,
+            out = prog(params, *carry_args, dispatch_key, epoch0_dev,
                        *trace_args, *lr_args, *sched_args, *args, *eval_args)
-        return self._assemble_superstep(out, epoch0, k, eval_mask, fused_eval)
+        return self._assemble_superstep(out, epoch0, k, eval_mask, fused_eval,
+                                        arms=arms)
 
     def _assemble_superstep(self, out, epoch0: int, k: int, eval_mask,
-                            fused_eval):
+                            fused_eval, arms: int = 0):
         """Package one superstep dispatch's outputs: ``(new_params,
         PendingMetrics)``; shared by the eager and streaming paths.  Under a
         lossy wire codec the second output is the new error-feedback carry;
@@ -1349,7 +1578,14 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         either way stashed on the engine (read/restored via
         :meth:`wire_resid_host`/:meth:`set_wire_resid` or
         :meth:`~..sched.buffer._SchedBufCarry.sched_buf_host`/
-        :meth:`set_sched_buf` at checkpoint boundaries)."""
+        :meth:`set_sched_buf` at checkpoint boundaries).
+
+        ``arms`` (ISSUE 14): every fetched leaf carries the arms axis right
+        behind the round/eval-stack axis; the assemble slices each arm out
+        and runs the solo assembly on it, returning ``{"arms": [per-arm
+        results]}`` -- each entry exactly what a solo run's fetch yields
+        (probe records included), so downstream consumers are per-arm
+        unchanged."""
         if self._codec_name != "dense":
             self._resid = out[1]
             out = (out[0],) + out[2:]
@@ -1369,7 +1605,7 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         if eval_mask is None:
             new_params, ms = out
 
-            def _assemble(host):
+            def _assemble_one(host):
                 host, probes = _split(host)
                 rounds = [{name: v[r] for name, v in host.items()}
                           for r in range(k)]
@@ -1377,12 +1613,20 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                     return {"train": rounds, "obs": probes}
                 return rounds
 
-            return new_params, PendingMetrics(ms, assemble=_assemble)
+            if arms:
+                def _assemble(host):
+                    return {"arms": [
+                        _assemble_one({name: v[:, e]
+                                       for name, v in host.items()})
+                        for e in range(arms)]}
+
+                return new_params, PendingMetrics(ms, assemble=_assemble)
+            return new_params, PendingMetrics(ms, assemble=_assemble_one)
 
         new_params, ms, ev = out
         eval_epochs = [epoch0 + r for r, m in enumerate(eval_mask) if m]
 
-        def _assemble_eval(host):
+        def _assemble_eval_one(host):
             ms_h, ev_h = host
             ms_h, probes = _split(ms_h)
             out_d = {"train": [{name: v[r] for name, v in ms_h.items()}
@@ -1392,7 +1636,19 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                 out_d["obs"] = probes
             return out_d
 
-        return new_params, PendingMetrics((ms, ev), assemble=_assemble_eval)
+        if arms:
+            def _assemble_eval(host):
+                ms_h, ev_h = host
+                return {"arms": [
+                    _assemble_eval_one((
+                        {name: v[:, e] for name, v in ms_h.items()},
+                        jax.tree_util.tree_map(lambda v: v[:, e], ev_h)))
+                    for e in range(arms)]}
+
+            return new_params, PendingMetrics((ms, ev),
+                                              assemble=_assemble_eval)
+        return new_params, PendingMetrics((ms, ev),
+                                          assemble=_assemble_eval_one)
 
     def program_cache_size(self) -> int:
         """Total compiled specializations across this engine's train
@@ -1420,6 +1676,12 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         per-client metric sums)`` with the metric sums still on device.
         """
         self._reject_per_level_map()
+        if self._arms_spec is not None:
+            raise ValueError(
+                "arms need the fused superstep (train_superstep): the K=1 "
+                "train_round path is the host-loop reference twin, which "
+                "the arms axis would fork per arm -- set superstep_rounds "
+                ">= 1 through the superstep API")
         if self._train is None:
             self._train = self._build_train()
         timer = timer if timer is not None else PhaseTimer()

@@ -763,7 +763,158 @@ def _sched_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
         + data,
         {"donated": 1, "psum": PSUM_BUDGET, "wire_bytes": wire_map,
          "donated_bytes": resid_bytes, "mem": mem(per_dev_g)}))
+    # per-level codec map x slices layout (ISSUE 14 satellite, retiring
+    # the PR 9 refusal): every switch branch emits every level's payload
+    # structure (identity payloads for non-owned levels), so the single
+    # bind's operand bytes equal the SAME per-level byte-table sum as the
+    # span map -- enforced by equality against the traced avals
+    grp_pl_sl = GroupedRoundEngine(dict(mcfg, level_placement="slices"),
+                                   mesh)
+    grp_pl_sl._lr_fn = make_traced_lr_fn(cfg)
+    mode_sl, _ = grp_pl_sl._fused_layout()
+    if mode_sl == "slices":
+        need = max(_ceil_div(per_level,
+                             grp_pl_sl._slices[r][1] - grp_pl_sl._slices[r][0])
+                   for r in grp_pl_sl.levels)
+        per_dev_sl = _bucket_pow2(need)
+        targets.append((
+            "grouped/slices/k8-fused-perlevel",
+            grp_pl_sl._superstep_prog(k, per_dev_sl, "slices"),
+            (params, _sds((n_dev, 2, lay["total_lossy"]), np.float32), key,
+             np.int32(1), _sds((k, per_dev_sl * n_dev))) + data,
+            {"donated": 1, "psum": PSUM_BUDGET, "wire_bytes": wire_map,
+             "donated_bytes": resid_bytes, "mem": mem(per_dev_sl)}))
     return targets
+
+
+def _arms_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
+    """Arms-multiplexer variants (ISSUE 14): the E-arm vmapped supersteps
+    of both engines at ARMS-SCALED budgets.
+
+    The batched counted-average reduction stays EXACTLY one psum bind per
+    fused training round (a vmapped pytree psum is one bind -- the
+    ``psum`` budget does NOT scale with E), while the bind's operand
+    bytes scale linearly: the wire budget is ``E x`` the per-arm dense
+    reduction, enforced by equality against the traced avals.  The HBM
+    budget scales the params carry and the per-device client concurrency
+    by E (each arm's slot cohort trains concurrently).  Program FLOPs are
+    held to E-linearity by :func:`arms_flop_check` against the unbatched
+    twin.  Donation pins to ZERO leaves: donating the E-stacked params
+    carry trips the XLA:CPU deserialized-executable aliasing bug (see
+    ``round_engine._build_superstep``), so the arms programs keep the
+    carry undonated -- a budgeted extra params buffer, not a silent
+    coverage shortfall."""
+    import jax
+
+    from ..fed.core import arm_stream_keys
+    from ..multi import default_seeds
+    from ..parallel import GroupedRoundEngine, RoundEngine
+    from ..parallel.grouped import _bucket_pow2
+    from ..utils.optim import make_traced_lr_fn
+
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    params, key = setup["params"], setup["key"]
+    users = setup["users"]
+    n_dev = mesh.shape["clients"]
+    bt = setup["byte_table"]
+    top = max(bt)
+    wire = bt[top]["wire_bytes"]
+    k = 8
+    a = int(math.ceil(cfg["frac"] * users))
+    per_dev = _ceil_div(a, n_dev)
+    per_level = 2
+    per_dev_g = _bucket_pow2(_ceil_div(per_level, n_dev))
+    targets = []
+
+    def amem(cpd: int, e: int) -> Dict[str, int]:
+        m = _mem_expect(bt, top, cpd)
+        # the params carry (and its donated/output footprint) stacks E
+        # arms; per-device client concurrency multiplies the same way
+        return {"param_bytes": e * m["param_bytes"],
+                "activation_bytes": m["activation_bytes"],
+                "clients_per_device": e * cpd}
+
+    def stacked_params(e: int):
+        return jax.tree_util.tree_map(
+            lambda v: _sds((e,) + tuple(v.shape), v.dtype), dict(params))
+
+    for e in (2, 4):
+        acfg = dict(cfg, arms=e)
+        eng = RoundEngine(model, acfg, mesh)
+        eng._lr_fn = make_traced_lr_fn(cfg)
+        fix = (eng.fix_rates,) if eng.fix_rates is not None else ()
+        data = tuple(setup["data"]) + fix
+        keys_e = arm_stream_keys(key, default_seeds(e))
+        scales_e = np.ones(e, np.float32)
+        targets.append((
+            f"masked/replicated/k8-arms{e}",
+            eng._build_superstep(k, per_dev, True, num_active=a, arms=e),
+            (stacked_params(e), keys_e, np.int32(1), scales_e) + data,
+            {"donated": 0, "psum": PSUM_BUDGET,
+             "wire_bytes": e * wire, "mem": amem(per_dev, e)}))
+    grp = GroupedRoundEngine(dict(cfg, arms=2), mesh)
+    grp._lr_fn = make_traced_lr_fn(cfg)
+    keys_2 = arm_stream_keys(key, default_seeds(2))
+    # grouped arms share the host user/rate schedule, so the count masks
+    # are ARM-INVARIANT and vmap leaves them unbatched: the single bind
+    # carries E sum payloads + ONE counts payload -- (E+1)/2 x the dense
+    # wire, tighter than the masked engine's E x (whose per-arm cohorts
+    # batch the counts too).  Still enforced by equality.
+    targets.append((
+        "grouped/span/k8-fused-arms2",
+        grp._superstep_prog(k, per_dev_g, "span", arms=2),
+        (stacked_params(2), keys_2, np.int32(1), np.ones(2, np.float32),
+         _sds((k, len(grp.levels), per_dev_g * n_dev)))
+        + tuple(setup["data"]),
+        {"donated": 0, "psum": PSUM_BUDGET,
+         "wire_bytes": (2 + 1) * wire // 2,
+         "mem": amem(per_dev_g, 2)}))
+    return targets
+
+
+def arms_flop_check(report: "AuditReport") -> Dict[str, Any]:
+    """FLOP linearity of the arms axis (ISSUE 14): the MARGINAL cost of an
+    arm is constant -- ``flops(E=4) == 2 x flops(E=2)`` to 0.1% (each arm
+    re-runs the identical per-arm math; doubling the batch doubles it) --
+    and an E-arm program stays within a few percent of ``E x`` the
+    unbatched twin (the small super-E offset is the per-arm in-jit cohort
+    draw and LR scaling that the solo program binds only once; a blowout
+    here means the vmap fell off the batched lowering).  Read from the
+    per-program ``cost_analysis`` numbers already recorded by the audit
+    (nothing recompiles here)."""
+    out: Dict[str, Any] = {"ok": True, "pairs": {}}
+
+    def flops_of(name):
+        return getattr(report.programs.get(name), "flops", None)
+
+    f2 = flops_of("masked/replicated/k8-arms2")
+    f4 = flops_of("masked/replicated/k8-arms4")
+    if f2 and f4:
+        out["pairs"]["masked-arms4-vs-arms2"] = {
+            "flops": f4, "half_flops": f2, "ratio": round(f4 / f2, 6)}
+        if abs(f4 / f2 - 2.0) > 2e-3:
+            report.fail(out, "arms-flop-linearity",
+                        f"masked k8 arms4 compiled flops {f4:.4g} are "
+                        f"{f4 / f2:.6f}x arms2's ({f2:.4g}); the marginal "
+                        f"arm cost must be constant (2x to 0.1%)")
+    for arms_name, solo_name, e in (
+            ("masked/replicated/k8-arms2", "masked/replicated/k8", 2),
+            ("masked/replicated/k8-arms4", "masked/replicated/k8", 4),
+            ("grouped/span/k8-fused-arms2", "grouped/span/k8-fused", 2)):
+        fa, fs = flops_of(arms_name), flops_of(solo_name)
+        if not fa or not fs:
+            continue  # cost analysis unavailable on this backend
+        ratio = fa / fs
+        out["pairs"][arms_name] = {"flops": fa, "solo_flops": fs,
+                                   "ratio": round(ratio, 6), "expect": e}
+        if not e <= ratio <= 1.1 * e:
+            report.fail(out, "arms-flop-linearity",
+                        f"{arms_name}: compiled flops {fa:.4g} are "
+                        f"{ratio:.6f}x the unbatched {solo_name} "
+                        f"({fs:.4g}), outside [{e}, {1.1 * e:g}]: the "
+                        f"arms axis must scale FLOPs ~{e}x (per-arm draw "
+                        f"overhead only)")
+    return out
 
 
 def _obs_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
@@ -1274,6 +1425,24 @@ def recompile_hazard_check(setup) -> Dict[str, Any]:
                            fresh_lr(), jax.random.key(2))
     out["grouped_round"] = {"after_warm": size1,
                             "after_repeat": grp.program_cache_size()}
+
+    # arms superstep (ISSUE 14): the stacked per-arm key roots and LR
+    # scales are per-dispatch VALUES; the arms count is an engine
+    # constant.  A fresh-but-identical dispatch (new key derivation, new
+    # scale buffer) must hit the cached E-arm program.
+    eng_ar = RoundEngine(model, dict(cfg, arms=2), mesh)
+    par = jax.tree_util.tree_map(
+        lambda v: jax.numpy.stack([v, v]), model.init(jax.random.key(0)))
+    par, pend = eng_ar.train_superstep(par, jax.random.key(3), 1, 2, data,
+                                       num_active=4)
+    pend.fetch()
+    size1 = eng_ar.program_cache_size()
+    par, pend = eng_ar.train_superstep(par, jax.random.key(3), 3, 2, data,
+                                       num_active=4)
+    pend.fetch()
+    out["masked_arms_superstep"] = {"after_warm": size1,
+                                    "after_repeat":
+                                        eng_ar.program_cache_size()}
     return out
 
 
@@ -1400,6 +1569,7 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
     targets.extend(_sched_targets(setup))
     targets.extend(_obs_targets(setup))
     targets.extend(_obs_hist_targets(setup))
+    targets.extend(_arms_targets(setup))
     for name, prog, args, expect in targets:
         report.add_program(audit_program(name, prog, args, expect, mesh))
 
@@ -1407,6 +1577,7 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
                                            tol=flop_tol)
     report.wire_frontier = codec_frontier_check(report)
     report.sampler = sampler_stream_check(report, setup)
+    report.arms = arms_flop_check(report)
     if with_recompile_check:
         rc = recompile_hazard_check(setup)
         for which, sizes in list(rc.items()):

@@ -86,6 +86,9 @@ class AuditReport:
     #: -- in-jit == host draw bitwise for both sampler kinds, all-ones
     #: availability == uniform cohort, PRP exact bijection
     sampler: Dict[str, Any] = field(default_factory=dict)
+    #: arms-axis FLOP linearity (ISSUE 14: audit.arms_flop_check) -- an
+    #: E-arm program's compiled FLOPs == E x its unbatched twin's
+    arms: Dict[str, Any] = field(default_factory=dict)
     lint: List[Finding] = field(default_factory=list)
     #: baseline-ratchet diff (ISSUE 7: staticcheck/ratchet.py).  ``checked``
     #: is False unless the CLI ran ``--diff-baseline``; a regressed ratchet
@@ -115,7 +118,7 @@ class AuditReport:
         for p in self.programs.values():
             out.extend(p.findings)
         for sec in (self.flop_budget, self.recompile, self.wire_frontier,
-                    self.sampler):
+                    self.sampler, self.arms):
             out.extend(Finding(**f) for f in sec.get("findings", []))
         return out
 
@@ -130,6 +133,7 @@ class AuditReport:
             "recompile": self.recompile,
             "wire_frontier": self.wire_frontier,
             "sampler": self.sampler,
+            "arms": self.arms,
             "ratchet": self.ratchet,
             "lint": [asdict(f) for f in self.lint],
         }

@@ -1,0 +1,721 @@
+"""Experiment arms multiplexer (ISSUE 14): E sweep arms in ONE fused
+superstep program.
+
+The contracts under test:
+
+* **arms=1 == unbatched, bitwise**: an E=1 arms program with the identity
+  arm (seed ``None``) produces bit-identical params and metrics to the
+  plain superstep -- the arms axis is pure structure.
+* **arm i == solo**: arm *i* of a batched run equals an ``arms=1`` run
+  carrying the same seed/lr_scale (same stream derivation,
+  ``fed.core.arm_stream_keys``) -- BITWISE for the masked engine across
+  {replicated, sharded} x K x +-eval, including the int8 EF-residual
+  carry and the stacked telemetry probes.  The grouped span engine is
+  pinned at an explicit association tolerance instead (GROUPED_ARM_TOL):
+  XLA:CPU batch-lowers the small SLICED per-level convs with a different
+  accumulation order once the arms axis batches them (measured ~3e-7
+  relative on single weights), so bitwise equality would be a
+  lowering-choice lottery -- the standing-gates rule says pin the
+  tolerance explicitly rather than silently weaken the contract.
+* **per-arm checkpoint -> resume round-trip**: the multiplexed driver
+  blob resumes bit-identically to an uninterrupted run, and each arm's
+  exportable checkpoint carries that arm's params slice.
+* **loud refusals**: every unsupported combination fails at construction
+  with a ValueError, never as a silent single-arm fallback.
+"""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heterofl_tpu import config as C
+from heterofl_tpu.fed.core import (arm_stream_keys, superstep_rate_schedule,
+                                   superstep_user_schedule)
+from heterofl_tpu.models import make_model
+from heterofl_tpu.multi import (MAX_ARMS, ArmsSpec, default_seeds,
+                                resolve_arms_cfg)
+from heterofl_tpu.multi.sweep import arms_cfg_of, partition_grid
+from heterofl_tpu.parallel import (GroupedRoundEngine, RoundEngine,
+                                   make_mesh, shard_client_data)
+from heterofl_tpu.parallel.evaluation import Evaluator
+
+from test_round import _vision_setup
+
+HOST_KEY = jax.random.key(0)
+METRICS = ("loss_sum", "score_sum", "n", "rate")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(n_clients=2, n_data=1)
+
+    def batch(x, b):
+        n = x.shape[0]
+        s = math.ceil(n / b)
+        pad = s * b - n
+        w = np.concatenate([np.ones(n, np.float32),
+                            np.zeros(pad, np.float32)])
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        return x.reshape((s, b) + x.shape[1:]), w.reshape(s, b)
+
+    te = ds["test"]
+    xu = te.data[:96].reshape(8, 1, 12, 28, 28, 1)
+    yu = te.target[:96].reshape(8, 1, 12)
+    eval_ops = {"sbn": batch(ds["train"].data, 20),
+                "local": (xu, yu, np.ones((8, 1, 12), np.float32),
+                          np.ones((8, 10), np.float32)),
+                "global": batch(te.data, 20)[:1] + (batch(te.target, 20)[0],
+                                                    batch(te.data, 20)[1])}
+    xg, wg = batch(te.data, 20)
+    yg, _ = batch(te.target, 20)
+    eval_ops["global"] = (xg, yg, wg)
+    return {"cfg": cfg, "model": model, "mesh": mesh, "data": data,
+            "eval": eval_ops}
+
+
+def _p0(model):
+    return model.init(jax.random.key(0))
+
+
+def _stack(tree, n):
+    return jax.tree_util.tree_map(lambda v: jnp.stack([v] * n), tree)
+
+
+def _fused(setup, cfg):
+    es = setup["eval"]
+    ev = Evaluator(setup["model"], cfg, setup["mesh"], seed=0)
+    return ev.fused(sbn_batches=es["sbn"], local_eval=es["local"],
+                    global_eval=es["global"])
+
+
+#: the grouped arm-vs-solo association tolerance (see module docstring):
+#: explicit and pinned, NOT a convenience fudge -- masked stays bitwise
+GROUPED_ARM_TOL = dict(rtol=3e-6, atol=1e-7)
+
+
+def _assert_arm_close(p_batched, e, p_solo, out_batched, out_solo, k,
+                      tol=None):
+    def eq(a, b, msg):
+        a, b = np.asarray(a), np.asarray(b)
+        if tol is None:
+            np.testing.assert_array_equal(a, b, err_msg=msg)
+        else:
+            np.testing.assert_allclose(a, b, err_msg=msg, **tol)
+
+    for name in p_solo:
+        eq(p_batched[name][e], p_solo[name][0], name)
+    a_b, a_s = out_batched["arms"][e], out_solo["arms"][0]
+    rounds_b = a_b["train"] if isinstance(a_b, dict) else a_b
+    rounds_s = a_s["train"] if isinstance(a_s, dict) else a_s
+    for r in range(k):
+        for name in METRICS:
+            eq(rounds_b[r][name], rounds_s[r][name],
+               f"round {r} metric {name}")
+    if isinstance(a_s, dict) and a_s.get("eval"):
+        for ev_b, ev_s in zip(a_b["eval"], a_s["eval"]):
+            assert ev_b["epoch"] == ev_s["epoch"]
+            for n in ev_s["global"]:
+                eq(ev_b["global"][n], ev_s["global"][n], n)
+            for n in ev_s["local"]:
+                eq(ev_b["local"][n], ev_s["local"][n], n)
+            for site in ev_s["bn"]:
+                eq(np.asarray(ev_b["bn"][site][0]),
+                   np.asarray(ev_s["bn"][site][0]), site)
+
+
+# ---------------------------------------------------------------------------
+# config validation (multi.resolve_arms_cfg: THE one validator)
+# ---------------------------------------------------------------------------
+
+def test_resolve_arms_cfg_forms():
+    assert resolve_arms_cfg({}) is None
+    assert resolve_arms_cfg({"arms": None}) is None
+    spec = resolve_arms_cfg({"arms": 3})
+    assert spec.count == 3
+    assert spec.seeds == (None, 1, 2) == default_seeds(3)
+    assert spec.lr_scales == (1.0, 1.0, 1.0)
+    spec = resolve_arms_cfg({"arms": {"count": 2, "seeds": [7, None],
+                                      "lr_scales": [0.5, 2]}})
+    assert spec.seeds == (7, None) and spec.lr_scales == (0.5, 2.0)
+    assert spec.solo(0) == ArmsSpec(1, (7,), (0.5,))
+    assert hash(spec.solo(1)) == hash(ArmsSpec(1, (None,), (2.0,)))
+
+
+@pytest.mark.parametrize("raw,msg", [
+    (True, "Not valid arms"),
+    (0, "Not valid arms count"),
+    (-2, "Not valid arms count"),
+    (MAX_ARMS + 1, "MAX_ARMS"),
+    ("4", "Not valid arms"),
+    ({"count": 2, "bogus": 1}, "Not valid arms keys"),
+    ({"count": 2, "seeds": [1]}, "Not valid arms seeds"),
+    ({"count": 2, "seeds": [1, -3]}, "Not valid arm seed"),
+    ({"count": 2, "seeds": [1, True]}, "Not valid arm seed"),
+    ({"count": 2, "lr_scales": [1.0]}, "Not valid arms lr_scales"),
+    ({"count": 2, "lr_scales": [1.0, 0.0]}, "Not valid arm lr_scale"),
+    ({"count": 2, "lr_scales": [1.0, -1.0]}, "Not valid arm lr_scale"),
+])
+def test_resolve_arms_cfg_rejects(raw, msg):
+    with pytest.raises(ValueError, match=msg):
+        resolve_arms_cfg({"arms": raw})
+
+
+def test_process_control_validates_arms():
+    cfg = C.default_cfg()
+    cfg["control"]["num_users"] = "8"
+    cfg["data_name"] = "MNIST"
+    cfg["arms"] = {"count": 0}
+    with pytest.raises(ValueError, match="Not valid arms count"):
+        C.process_control(cfg)
+
+
+def test_arm_stream_keys_identity_and_fold():
+    keys = arm_stream_keys(HOST_KEY, (None, 3))
+    assert np.array_equal(jax.random.key_data(keys[0]),
+                          jax.random.key_data(HOST_KEY))
+    assert not np.array_equal(jax.random.key_data(keys[1]),
+                              jax.random.key_data(HOST_KEY))
+    # per-seed streams are distinct and deterministic
+    again = arm_stream_keys(HOST_KEY, (None, 3))
+    assert np.array_equal(jax.random.key_data(keys[1]),
+                          jax.random.key_data(again[1]))
+
+
+# ---------------------------------------------------------------------------
+# sweep partitioning (multi.sweep)
+# ---------------------------------------------------------------------------
+
+def test_partition_grid_arm_vs_structural():
+    launches = partition_grid({"seed": [0, 1], "lr": [0.1, 0.01],
+                               "wire_codec": ["dense", "int8"]}, max_arms=8)
+    assert len(launches) == 2  # one per structural value, 4 arms each
+    structs = sorted(s["wire_codec"] for s, _ in launches)
+    assert structs == ["dense", "int8"]
+    assert all(len(batch) == 4 for _, batch in launches)
+    # chunking at max_arms
+    launches = partition_grid({"seed": list(range(5))}, max_arms=2)
+    assert [len(b) for _, b in launches] == [2, 2, 1]
+
+
+def test_partition_grid_rejects():
+    with pytest.raises(ValueError, match="Not valid grid"):
+        partition_grid({}, max_arms=2)
+    with pytest.raises(ValueError, match="empty value list"):
+        partition_grid({"seed": []})
+    with pytest.raises(ValueError, match="both 'seed' and 'init_seed'"):
+        partition_grid({"seed": [0], "init_seed": [1]})
+    with pytest.raises(ValueError, match="Not valid grid seed"):
+        partition_grid({"seed": [-1]})
+    with pytest.raises(ValueError, match="Not valid grid lr"):
+        partition_grid({"lr": [0.0]})
+    with pytest.raises(ValueError, match="Not valid max_arms"):
+        partition_grid({"seed": [0]}, max_arms=0)
+
+
+def test_arms_cfg_of_scales_against_resolved_lr():
+    cfg = {"lr": 0.1}
+    arms = arms_cfg_of(cfg, [(0, None), (1, 0.05)])
+    assert arms["count"] == 2 and arms["seeds"] == [0, 1]
+    np.testing.assert_allclose(arms["lr_scales"], [1.0, 0.5])
+
+
+def test_sweep_dry_run(capsys):
+    from heterofl_tpu.multi.sweep import main
+
+    rc = main(["--grid", json.dumps({"seed": [0, 1]}), "--dry_run", "1"])
+    assert rc == 0
+    outp = capsys.readouterr().out
+    assert "launch 0" in outp and "E=2" in outp
+    # a typo'd structural key fails UP FRONT (dry-run included), never
+    # mid-sweep after earlier launches already burned their compiles
+    with pytest.raises(ValueError, match="structural grid key"):
+        main(["--grid", json.dumps({"seed": [0, 1], "superstep": [4]}),
+              "--dry_run", "1"])
+
+
+def test_launch_cfg_isolated_output_dirs(tmp_path):
+    """Launches share model tags (make_model_tag ignores structural
+    keys), so each must get its own output root -- a flat dir would
+    clobber sibling launches' per-arm checkpoints and cross-resume."""
+    from heterofl_tpu.multi.sweep import launch_cfg, partition_grid
+
+    base = _driver_args(tmp_path)
+    launches = partition_grid({"seed": [0, 1, 2, 3]}, max_arms=2)
+    cfgs = [launch_cfg(base, i, s, b) for i, (s, b) in enumerate(launches)]
+    assert len(cfgs) == 2
+    assert cfgs[0]["output_dir"] != cfgs[1]["output_dir"]
+    assert all(c["output_dir"].startswith(str(tmp_path)) for c in cfgs)
+    assert cfgs[0]["arms"]["seeds"] == [0, 1]
+    assert cfgs[1]["arms"]["seeds"] == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# loud refusals
+# ---------------------------------------------------------------------------
+
+def test_refusals(setup):
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    with pytest.raises(ValueError, match="buffered"):
+        RoundEngine(model, dict(cfg, arms=2,
+                                schedule={"aggregation": "buffered"}), mesh)
+    with pytest.raises(ValueError, match="client_store"):
+        RoundEngine(model, dict(cfg, arms=2, client_store="stream"), mesh)
+    eng = RoundEngine(model, dict(cfg, arms=2), mesh)
+    with pytest.raises(ValueError, match="fused superstep"):
+        eng.train_round(_stack(_p0(model), 2), HOST_KEY, 0.01,
+                        np.array([0, 1]), setup["data"])
+    with pytest.raises(ValueError, match="dense wire codec"):
+        GroupedRoundEngine(dict(cfg, arms=2, wire_codec="int8"), mesh)
+    with pytest.raises(ValueError, match="telemetry"):
+        GroupedRoundEngine(dict(cfg, arms=2, telemetry="on"), mesh)
+    with pytest.raises(ValueError, match="span"):
+        GroupedRoundEngine(dict(cfg, arms=2, level_placement="slices"),
+                           make_mesh(8, 1))
+    geng = GroupedRoundEngine(dict(cfg, arms=2), mesh)
+    with pytest.raises(ValueError, match="fused grouped superstep"):
+        geng.train_round(_p0(model), np.array([0, 1]),
+                         np.array([1.0, 1.0]), setup["data"], 0.01, HOST_KEY)
+
+
+# ---------------------------------------------------------------------------
+# E=1 == unbatched, bitwise (the identity-arm contract)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 8])
+def test_e1_bitwise_unbatched_masked(setup, k):
+    cfg, model, mesh, data = (setup["cfg"], setup["model"], setup["mesh"],
+                              setup["data"])
+    eng0 = RoundEngine(model, dict(cfg), mesh)
+    p_ref, pm = eng0.train_superstep(_p0(model), HOST_KEY, 1, k, data=data)
+    ms_ref = pm.fetch()
+    eng1 = RoundEngine(model, dict(cfg, arms=1), mesh)
+    p1, pm1 = eng1.train_superstep(_stack(_p0(model), 1), HOST_KEY, 1, k,
+                                   data=data)
+    out1 = pm1.fetch()
+    for name in p_ref:
+        np.testing.assert_array_equal(np.asarray(p1[name][0]),
+                                      np.asarray(p_ref[name]), err_msg=name)
+    for r in range(k):
+        for name in METRICS:
+            np.testing.assert_array_equal(
+                np.asarray(out1["arms"][0][r][name]),
+                np.asarray(ms_ref[r][name]), err_msg=f"{r}/{name}")
+
+
+@pytest.mark.slow
+def test_e1_bitwise_unbatched_grouped(setup):
+    cfg, model, mesh, data = (setup["cfg"], setup["model"], setup["mesh"],
+                              setup["data"])
+    k = 4
+    users = superstep_user_schedule(HOST_KEY, 1, k, cfg["num_users"], 4)
+    rates = superstep_rate_schedule(HOST_KEY, 1, k, cfg, users)
+    eng0 = GroupedRoundEngine(dict(cfg), mesh)
+    p_ref, pm = eng0.train_superstep(_p0(model), HOST_KEY, 1, k, users,
+                                     rates, data)
+    pm.fetch()
+    eng1 = GroupedRoundEngine(dict(cfg, arms=1), mesh)
+    p1, pm1 = eng1.train_superstep(_stack(_p0(model), 1), HOST_KEY, 1, k,
+                                   users, rates, data)
+    pm1.fetch()
+    for name in p_ref:
+        np.testing.assert_array_equal(np.asarray(p1[name][0]),
+                                      np.asarray(p_ref[name]), err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# arm-vs-solo equivalence matrix
+# ---------------------------------------------------------------------------
+
+ARMS3 = {"count": 3, "seeds": [None, 7, 11], "lr_scales": [1.0, 0.5, 2.0]}
+SOLO1 = {"count": 1, "seeds": [7], "lr_scales": [0.5]}
+
+
+@pytest.mark.parametrize("k,with_eval", [
+    (1, False), (8, False),
+    pytest.param(8, True, marks=pytest.mark.slow)])
+def test_arm_vs_solo_masked_replicated(setup, k, with_eval):
+    cfg, model, mesh, data = (setup["cfg"], setup["model"], setup["mesh"],
+                              setup["data"])
+    mask = tuple((r + 1) % 4 == 0 for r in range(k)) if with_eval else None
+    cfg_b = dict(cfg, arms=ARMS3)
+    eng_b = RoundEngine(model, cfg_b, mesh)
+    p_b, pm_b = eng_b.train_superstep(
+        _stack(_p0(model), 3), HOST_KEY, 1, k, data=data, eval_mask=mask,
+        fused_eval=_fused(setup, cfg_b) if with_eval else None)
+    out_b = pm_b.fetch()
+    cfg_s = dict(cfg, arms=SOLO1)
+    eng_s = RoundEngine(model, cfg_s, mesh)
+    p_s, pm_s = eng_s.train_superstep(
+        _stack(_p0(model), 1), HOST_KEY, 1, k, data=data, eval_mask=mask,
+        fused_eval=_fused(setup, cfg_s) if with_eval else None)
+    out_s = pm_s.fetch()
+    _assert_arm_close(p_b, 1, p_s, out_b, out_s, k)
+    # distinct seeds produce distinct trajectories (not a degenerate pass)
+    a0 = out_b["arms"][0]["train"] if with_eval else out_b["arms"][0]
+    a1 = out_b["arms"][1]["train"] if with_eval else out_b["arms"][1]
+    assert any(not np.array_equal(np.asarray(a0[r]["loss_sum"]),
+                                  np.asarray(a1[r]["loss_sum"]))
+               for r in range(k))
+
+
+@pytest.mark.slow
+def test_arm_vs_solo_masked_sharded(setup):
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    k = 4
+    sdata = shard_client_data(mesh, tuple(np.asarray(a)
+                                          for a in setup["data"]))
+    sched = superstep_user_schedule(HOST_KEY, 1, k, cfg["num_users"], 4)
+    eng_b = RoundEngine(model, dict(cfg, arms=ARMS3,
+                                    data_placement="sharded"), mesh)
+    p_b, pm_b = eng_b.train_superstep(_stack(_p0(model), 3), HOST_KEY, 1, k,
+                                      data=sdata, user_schedule=sched)
+    out_b = pm_b.fetch()
+    eng_s = RoundEngine(model, dict(cfg, arms=SOLO1,
+                                    data_placement="sharded"), mesh)
+    p_s, pm_s = eng_s.train_superstep(_stack(_p0(model), 1), HOST_KEY, 1, k,
+                                      data=sdata, user_schedule=sched)
+    out_s = pm_s.fetch()
+    _assert_arm_close(p_b, 1, p_s, out_b, out_s, k)
+
+
+@pytest.mark.parametrize("k,with_eval", [
+    pytest.param(1, False, marks=pytest.mark.slow),
+    pytest.param(8, True, marks=pytest.mark.slow)])
+def test_arm_vs_solo_grouped_span(setup, k, with_eval):
+    cfg, model, mesh, data = (setup["cfg"], setup["model"], setup["mesh"],
+                              setup["data"])
+    users = superstep_user_schedule(HOST_KEY, 1, k, cfg["num_users"], 4)
+    rates = superstep_rate_schedule(HOST_KEY, 1, k, cfg, users)
+    mask = tuple((r + 1) % 4 == 0 for r in range(k)) if with_eval else None
+    cfg_b = dict(cfg, arms=ARMS3)
+    eng_b = GroupedRoundEngine(cfg_b, mesh)
+    p_b, pm_b = eng_b.train_superstep(
+        _stack(_p0(model), 3), HOST_KEY, 1, k, users, rates, data,
+        eval_mask=mask, fused_eval=_fused(setup, cfg_b) if with_eval
+        else None)
+    out_b = pm_b.fetch()
+    cfg_s = dict(cfg, arms=SOLO1)
+    eng_s = GroupedRoundEngine(cfg_s, mesh)
+    p_s, pm_s = eng_s.train_superstep(
+        _stack(_p0(model), 1), HOST_KEY, 1, k, users, rates, data,
+        eval_mask=mask, fused_eval=_fused(setup, cfg_s) if with_eval
+        else None)
+    out_s = pm_s.fetch()
+    _assert_arm_close(p_b, 1, p_s, out_b, out_s, k, tol=GROUPED_ARM_TOL)
+
+
+# ---------------------------------------------------------------------------
+# the arms MESH placement (the 'experiments' mesh dimension)
+# ---------------------------------------------------------------------------
+
+MESH_ARMS = {"count": 4, "seeds": [None, 7, 9, 11],
+             "lr_scales": [1.0, 0.5, 2.0, 1.0]}
+
+
+def test_mesh_arms_placement_bitwise(setup):
+    """Arms laid over a dedicated mesh axis (make_mesh(n_arms=E): each
+    arm's federation on its own device rows, executing concurrently) are
+    BITWISE-identical to the vmap placement -- and therefore to solo runs:
+    the placement is pure layout, never semantics."""
+    cfg, model, data = setup["cfg"], setup["model"], setup["data"]
+    k, E = 4, 4
+    eng_v = RoundEngine(model, dict(cfg, arms=MESH_ARMS), make_mesh(2, 1))
+    p_v, pm_v = eng_v.train_superstep(_stack(_p0(model), E), HOST_KEY, 1, k,
+                                      data=data)
+    out_v = pm_v.fetch()
+    mesh_m = make_mesh(2, 1, n_arms=E)
+    assert mesh_m.shape["arms"] == E
+    eng_m = RoundEngine(model, dict(cfg, arms=MESH_ARMS), mesh_m)
+    p_m, pm_m = eng_m.train_superstep(_stack(_p0(model), E), HOST_KEY, 1, k,
+                                      data=data)
+    out_m = pm_m.fetch()
+    for name in p_v:
+        np.testing.assert_array_equal(np.asarray(p_m[name]),
+                                      np.asarray(p_v[name]), err_msg=name)
+    for e in range(E):
+        for r in range(k):
+            for nm in METRICS:
+                np.testing.assert_array_equal(
+                    np.asarray(out_m["arms"][e][r][nm]),
+                    np.asarray(out_v["arms"][e][r][nm]),
+                    err_msg=f"arm {e} round {r} {nm}")
+
+
+def test_mesh_arms_refusals(setup):
+    cfg, model = setup["cfg"], setup["model"]
+    mesh_m = make_mesh(2, 1, n_arms=4)
+    with pytest.raises(ValueError, match="'arms' axis but cfg"):
+        RoundEngine(model, dict(cfg), mesh_m)
+    with pytest.raises(ValueError, match="arms axis size"):
+        RoundEngine(model, dict(cfg, arms=2), mesh_m)
+    with pytest.raises(ValueError, match="grouped engine"):
+        GroupedRoundEngine(dict(cfg, arms=4), mesh_m)
+
+
+# ---------------------------------------------------------------------------
+# wire codec x arms: the EF residual batches per arm
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_codec_arms_resid_batches_and_roundtrips(setup):
+    cfg, model, mesh, data = (setup["cfg"], setup["model"], setup["mesh"],
+                              setup["data"])
+    k, E = 4, 2
+    arms2 = {"count": 2, "seeds": [None, 7], "lr_scales": [1.0, 0.5]}
+    eng_b = RoundEngine(model, dict(cfg, arms=arms2, wire_codec="int8"),
+                        mesh)
+    p_b, pm_b = eng_b.train_superstep(_stack(_p0(model), E), HOST_KEY, 1, k,
+                                      data=data)
+    out_b = pm_b.fetch()
+    assert eng_b._resid.shape[0] == E  # [E, n_dev, slots, total]
+    eng_s = RoundEngine(model, dict(cfg, arms={"count": 1, "seeds": [7],
+                                               "lr_scales": [0.5]},
+                                    wire_codec="int8"), mesh)
+    p_s, pm_s = eng_s.train_superstep(_stack(_p0(model), 1), HOST_KEY, 1, k,
+                                      data=data)
+    out_s = pm_s.fetch()
+    _assert_arm_close(p_b, 1, p_s, out_b, out_s, k)
+    np.testing.assert_array_equal(np.asarray(eng_b._resid[1]),
+                                  np.asarray(eng_s._resid[0]))
+    # checkpoint round-trip of the stacked carry: restore + redispatch
+    # bit-identical to the uninterrupted engine
+    host = eng_b.wire_resid_host()
+    assert host.shape[0] == E
+    eng_c = RoundEngine(model, dict(cfg, arms=arms2, wire_codec="int8"),
+                        mesh)
+    eng_c.set_wire_resid(host)
+    p_c, pm_c = eng_c.train_superstep(p_b, HOST_KEY, 1 + k, k, data=data)
+    pm_c.fetch()
+    p_u, pm_u = eng_b.train_superstep(
+        jax.tree_util.tree_map(lambda v: v + 0, p_b), HOST_KEY, 1 + k, k,
+        data=data)
+    pm_u.fetch()
+    for name in p_u:
+        np.testing.assert_array_equal(np.asarray(p_c[name]),
+                                      np.asarray(p_u[name]), err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# telemetry x arms: probes come back stacked per arm
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_obs_arms_probes_per_arm(setup):
+    cfg, model, mesh, data = (setup["cfg"], setup["model"], setup["mesh"],
+                              setup["data"])
+    k = 4
+    arms2 = {"count": 2, "seeds": [None, 7], "lr_scales": [1.0, 1.0]}
+    eng_on = RoundEngine(model, dict(cfg, arms=arms2, telemetry="on"), mesh)
+    p_on, pm_on = eng_on.train_superstep(_stack(_p0(model), 2), HOST_KEY, 1,
+                                         k, data=data)
+    out_on = pm_on.fetch()
+    for e in range(2):
+        arm = out_on["arms"][e]
+        assert "obs" in arm and len(arm["obs"]) == k
+        for rec in arm["obs"]:
+            assert rec["nonfinite"] == 0
+            assert rec["update_norm"] > 0
+    assert out_on["arms"][0]["obs"][0]["update_norm"] != \
+        out_on["arms"][1]["obs"][0]["update_norm"]
+    # telemetry on == off, bitwise, per arm
+    eng_off = RoundEngine(model, dict(cfg, arms=arms2), mesh)
+    p_off, pm_off = eng_off.train_superstep(_stack(_p0(model), 2), HOST_KEY,
+                                            1, k, data=data)
+    out_off = pm_off.fetch()
+    for name in p_off:
+        np.testing.assert_array_equal(np.asarray(p_on[name]),
+                                      np.asarray(p_off[name]), err_msg=name)
+    for e in range(2):
+        rounds_on = out_on["arms"][e]["train"]
+        for r in range(k):
+            for name in METRICS:
+                np.testing.assert_array_equal(
+                    np.asarray(rounds_on[r][name]),
+                    np.asarray(out_off["arms"][e][r][name]))
+
+
+# ---------------------------------------------------------------------------
+# the multiplexed driver: per-arm logs, checkpoints, resume
+# ---------------------------------------------------------------------------
+
+def _driver_args(tmp, n_rounds=4):
+    ov = {"num_epochs": {"global": n_rounds, "local": 1},
+          "conv": {"hidden_size": [8, 16]},
+          "batch_size": {"train": 10, "test": 20}}
+    cfg = C.default_cfg()
+    cfg["control"] = C.parse_control_name("1_8_0.5_iid_fix_a1-b1-c1-d1-e1_bn_1_1")
+    cfg["data_name"] = "MNIST"
+    cfg["model_name"] = "conv"
+    cfg["synthetic"] = True
+    cfg["synthetic_sizes"] = {"train": 200, "test": 80}
+    cfg["output_dir"] = str(tmp)
+    cfg["override"] = ov
+    cfg["superstep_rounds"] = 2
+    cfg["eval_interval"] = 2
+    return cfg
+
+
+def test_fedexperiment_refuses_arms_cfg(tmp_path):
+    from heterofl_tpu.entry.common import FedExperiment
+
+    cfg = _driver_args(tmp_path)
+    cfg["arms"] = 2
+    cfg = C.process_control(cfg)
+    with pytest.raises(ValueError, match="multiplexed driver"):
+        FedExperiment(cfg, 0)
+
+
+def test_arms_experiment_requires_arms(tmp_path):
+    from heterofl_tpu.entry.common import ArmsExperiment
+
+    cfg = C.process_control(_driver_args(tmp_path))
+    with pytest.raises(ValueError, match="needs cfg\\['arms'\\]"):
+        ArmsExperiment(cfg, 0)
+
+
+def test_arms_driver_refusals(tmp_path):
+    from heterofl_tpu.entry.common import ArmsExperiment
+
+    # trace_dir x arms: the multiplexed loop builds no TraceRecorder, so
+    # the trace would be silently empty -- refuse at construction
+    cfg = _driver_args(tmp_path)
+    cfg["arms"] = 2
+    cfg["trace_dir"] = str(tmp_path / "tr")
+    cfg = C.process_control(cfg)
+    with pytest.raises(ValueError, match="trace_dir"):
+        ArmsExperiment(cfg, 0)
+    # an explicit arms mesh axis the device count cannot honor must
+    # raise, not silently fall back to the vmap placement
+    cfg = _driver_args(tmp_path)
+    cfg["arms"] = 2
+    cfg["mesh"] = {"clients": len(jax.devices()), "data": 1, "arms": 2}
+    cfg = C.process_control(cfg)
+    with pytest.raises(ValueError, match="devices"):
+        ArmsExperiment(cfg, 0)
+
+
+@pytest.mark.slow
+def test_driver_arms_end_to_end_and_resume(tmp_path):
+    """4-round 2-arm multiplexed run: per-arm JSONL lines + checkpoints,
+    then a mid-run resume that matches the uninterrupted run bitwise."""
+    from heterofl_tpu.entry.common import ArmsExperiment
+
+    arms = {"count": 2, "seeds": [None, 7], "lr_scales": [1.0, 0.5]}
+
+    def run(tmp, n_rounds):
+        cfg = _driver_args(tmp, n_rounds=n_rounds)
+        cfg["arms"] = dict(arms)
+        cfg = C.process_control(cfg)
+        exp = ArmsExperiment(cfg, 0)
+        return exp, exp.run("Global-Accuracy", "max")
+
+    exp, res = run(tmp_path / "full", 4)
+    tag = exp._arms_tag()
+    # per-arm log lines with the arm field
+    log = tmp_path / "full" / "runs" / f"train_{tag}" / "log.jsonl"
+    lines = [json.loads(ln) for ln in open(log)]
+    arms_lines = [ln for ln in lines if ln.get("tag") == "arms"]
+    trains = [ln for ln in arms_lines if ln["event"] == "train"]
+    evals = [ln for ln in arms_lines if ln["event"] == "eval"]
+    assert {ln["arm"] for ln in arms_lines} == {0, 1}
+    assert len(trains) == 2 * 4 and len(evals) == 2 * 2
+    # per-arm metrics differ across seeds
+    l0 = [ln["loss"] for ln in trains if ln["arm"] == 0]
+    l1 = [ln["loss"] for ln in trains if ln["arm"] == 1]
+    assert l0 != l1
+    # per-arm checkpoints carry each arm's params slice
+    for e in range(2):
+        ck = tmp_path / "full" / "model" / f"{tag}_a{e}_checkpoint.pkl"
+        assert ck.exists(), os.listdir(tmp_path / "full" / "model")
+    import pickle
+    with open(tmp_path / "full" / "model" / f"{tag}_a1_checkpoint.pkl",
+              "rb") as f:
+        blob1 = pickle.load(f)
+    assert blob1["arm"] == 1 and blob1["arm_seed"] == 7
+    for name, v in blob1["params"].items():
+        np.testing.assert_array_equal(v, np.asarray(res["params"][name][1]),
+                                      err_msg=name)
+    # resume round-trip: 2 rounds, stop, resume 2 more == 4 uninterrupted
+    exp_a, res_a = run(tmp_path / "half", 2)
+    cfg_b = _driver_args(tmp_path / "half", n_rounds=4)
+    cfg_b["arms"] = dict(arms)
+    cfg_b["resume_mode"] = 1
+    cfg_b = C.process_control(cfg_b)
+    exp_b = ArmsExperiment(cfg_b, 0)
+    res_b = exp_b.run("Global-Accuracy", "max")
+    for name in res["params"]:
+        np.testing.assert_array_equal(np.asarray(res_b["params"][name]),
+                                      np.asarray(res["params"][name]),
+                                      err_msg=name)
+
+
+@pytest.mark.slow
+def test_driver_arms_plateau_per_arm(tmp_path):
+    """ReduceLROnPlateau x arms: each arm owns its own scheduler state,
+    staged into the program as the [E] LR vector -- and the arm's
+    lr_scale multiplies the scheduler's output (a Plateau LR sweep must
+    train each arm at ITS grid value, not silently at the base LR)."""
+    from heterofl_tpu.entry.common import ArmsExperiment
+
+    cfg = _driver_args(tmp_path, n_rounds=4)
+    cfg["arms"] = {"count": 2, "seeds": [None, 7], "lr_scales": [1.0, 0.25]}
+    cfg["override"] = dict(cfg["override"],
+                           scheduler_name="ReduceLROnPlateau")
+    cfg = C.process_control(cfg)
+    exp = ArmsExperiment(cfg, 0)
+    res = exp.run("Global-Accuracy", "max")
+    assert len(exp._arm_scheds) == 2
+    log = (tmp_path / "runs" / f"train_{exp._arms_tag()}" / "log.jsonl")
+    lines = [json.loads(ln) for ln in open(log)]
+    trains = [ln for ln in lines
+              if ln.get("tag") == "arms" and ln["event"] == "train"]
+    assert all(np.isfinite(ln["lr"]) for ln in trains)
+    lr_by_arm = {e: {ln["epoch"]: ln["lr"] for ln in trains
+                     if ln["arm"] == e} for e in (0, 1)}
+    for ep, lr0 in lr_by_arm[0].items():
+        assert lr_by_arm[1][ep] == pytest.approx(0.25 * lr0)
+    assert all(np.isfinite(v) for name in res["params"]
+               for v in [float(np.abs(np.asarray(res["params"][name])).max())])
+    # the STAGED [E] LR vector carries the scale too: identical seeds with
+    # scales (1.0, 0.25) must diverge (the LR is the arms' only delta)
+    cfg2 = _driver_args(tmp_path / "scaled", n_rounds=2)
+    cfg2["arms"] = {"count": 2, "seeds": [None, None],
+                    "lr_scales": [1.0, 0.25]}
+    cfg2["override"] = dict(cfg2["override"],
+                            scheduler_name="ReduceLROnPlateau")
+    cfg2 = C.process_control(cfg2)
+    res2 = ArmsExperiment(cfg2, 0).run("Global-Accuracy", "max")
+    assert any(not np.array_equal(np.asarray(v[0]), np.asarray(v[1]))
+               for v in res2["params"].values())
+
+
+@pytest.mark.slow
+def test_driver_arms_telemetry_probes(tmp_path):
+    """telemetry='on' x arms: the multiplexed loop surfaces the stacked
+    obs records it fetches -- per-arm probes events land on the run log
+    (each arm also feeds its own watchdog; one shared spike window would
+    mix E loss streams)."""
+    from heterofl_tpu.entry.common import ArmsExperiment
+
+    cfg = _driver_args(tmp_path, n_rounds=2)
+    cfg["arms"] = {"count": 2, "seeds": [None, 7], "lr_scales": [1.0, 1.0]}
+    cfg["telemetry"] = "on"
+    cfg = C.process_control(cfg)
+    exp = ArmsExperiment(cfg, 0)
+    assert exp._arm_watchdogs is None or len(exp._arm_watchdogs) == 2
+    exp.run("Global-Accuracy", "max")
+    log = tmp_path / "runs" / f"train_{exp._arms_tag()}" / "log.jsonl"
+    probes = [ln for ln in map(json.loads, open(log))
+              if ln.get("event") == "probes"]
+    assert {ln["arm"] for ln in probes} == {0, 1}
+    assert len(probes) == 2 * 2  # E arms x n_rounds
+    assert all(ln["update_norm"] > 0 and ln["nonfinite"] == 0
+               for ln in probes)

@@ -347,6 +347,15 @@ def test_wire_memory_reshard_sections_on_every_program(audit_report):
             assert p.wire["train_bytes_per_round"] == expected, name
         elif codec:  # compressed fused round: that codec's level-a payload
             assert p.wire["train_bytes_per_round"] == codec_wire[codec], name
+        elif "-arms" in name:
+            # arms multiplexer (ISSUE 14): the masked engine's per-arm
+            # cohorts batch sums AND counts -- E x the dense reduction;
+            # grouped span arms share the host schedule, so the counts
+            # payload is arm-invariant: E sum payloads + ONE counts
+            e = int(name.split("-arms")[1])
+            expected = (e + 1) * level_a_wire // 2 \
+                if name.startswith("grouped") else e * level_a_wire
+            assert p.wire["train_bytes_per_round"] == expected, name
         else:  # every fused training round (incl. the ISSUE 9 trace/
             # deadline/buffered scheduler variants -- selection arithmetic
             # and post-psum buffering add no wire): the dense level-a
