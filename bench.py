@@ -103,6 +103,16 @@ count divides: each arm on its own mesh rows, executing concurrently) or
 vmap (batched per device).  Needs BENCH_SUPERSTEP>1; skipped under
 population/scenario/codec knobs.
 
+BENCH_CHAOS=1 (ISSUE 15): the fault-tolerance drill measurements -- one
+watchdog-rollback poison drill (seeded NaN client update, auto-recovery)
+and one quarantine poison drill on the drill's small synthetic
+federation, recorded into extra.chaos: rollback-recovery MTTR (trip ->
+first replayed train record) and wall clock, trip/recovery counts, and
+the quarantined-client count.  If the rollback recovery ESCALATES to
+abort the record is refused -- extra.chaos carries the escalation
+evidence instead of an MTTR, because a recovery time measured through a
+run that needed human intervention is not a recovery time.
+
 BENCH_LEDGER=1 (ISSUE 12): the population-observatory A/B -- one measure
 with telemetry='hist' (cohort histograms riding the metrics fetch) PLUS a
 host-side ClientLedger folded O(active) per fetch from the recomputed
@@ -1060,6 +1070,7 @@ def main():
     step_ab = {}  # filled by the BENCH_STEP_AB pass; emitted when non-empty
     obs_ab = {}   # filled by the BENCH_TELEMETRY pass; emitted when non-empty
     arms_ab = {}  # filled by the BENCH_ARMS pass (ISSUE 14)
+    chaos_ab = {}  # filled by the BENCH_CHAOS pass (ISSUE 15)
 
     def emit(ctx, rounds_done, strategies=None):
         # a degraded (non-flagship-volume / wrong-platform) run must not
@@ -1134,6 +1145,7 @@ def main():
                       **({"step_ab": step_ab} if step_ab else {}),
                       **({"obs": obs_ab} if obs_ab else {}),
                       **({"arms": arms_ab} if arms_ab else {}),
+                      **({"chaos": chaos_ab} if chaos_ab else {}),
                       **({"degraded": degraded} if degraded else {})},
         }), flush=True)
 
@@ -1585,6 +1597,49 @@ def main():
                 arms_ab.update({"error": repr(e)})
                 print(f"bench: arms A/B failed: {e!r}", file=sys.stderr)
             emit(ctx, timed_rounds, strategies=strategies or None)
+
+    # BENCH_CHAOS=1 (ISSUE 15): the fault-tolerance drill measurements --
+    # a watchdog-rollback poison drill (seeded NaN, auto-recovery MTTR)
+    # and a quarantine poison drill, on the drill's small synthetic
+    # federation (its own programs; the flagship measure above is
+    # untouched).  An escalation to abort REFUSES the record: a recovery
+    # that needed human intervention has no MTTR.
+    if os.environ.get("BENCH_CHAOS") == "1":
+        try:
+            import tempfile
+
+            from heterofl_tpu.chaos.drill import run_poison_drill
+            from heterofl_tpu.obs.watchdog import WatchdogError
+
+            hb("[chaos] rollback + quarantine poison drills")
+            chaos_root = tempfile.mkdtemp(prefix="bench_chaos_")
+            try:
+                roll = run_poison_drill(
+                    "rollback", {}, os.path.join(chaos_root, "rollback"))
+            except WatchdogError as e:
+                chaos_ab.update({
+                    "error": "rollback recovery escalated to abort; "
+                             "refusing to record an MTTR",
+                    "escalation": repr(e)})
+            else:
+                quar = run_poison_drill(
+                    "quarantine", {}, os.path.join(chaos_root, "quarantine"))
+                chaos_ab.update({
+                    "rollback": {
+                        "ok": roll["ok"], "poison": roll["poison"],
+                        "trips": roll["trips"],
+                        "recoveries": roll["recoveries"],
+                        "mttr_sec": roll["mttr_sec"],
+                        "wall_sec": roll["wall_sec"]},
+                    "quarantine": {
+                        "ok": quar["ok"], "poison": quar["poison"],
+                        "quarantined_total": quar["quarantined_total"],
+                        "wall_sec": quar["wall_sec"]},
+                })
+        except Exception as e:
+            chaos_ab.update({"error": repr(e)})
+            print(f"bench: chaos drills failed: {e!r}", file=sys.stderr)
+        emit(ctx, timed_rounds, strategies=strategies or None)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,37 @@
+"""Chaos fault injection, jax half (ISSUE 15): the in-program NaN poison.
+
+One function, called from both engines' round cores right after local
+training -- the poisoned client's *update* goes NaN before aggregation,
+exactly the adversarial-client model PAPERS.md 1610.05492 assumes the
+aggregator survives.  The poison table is a trace-time constant (resolved
+once at engine construction from ``cfg['chaos_poison']``), so unpoisoned
+engines build byte-identical programs with zero new arguments.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def poison_updates(trained, table: np.ndarray, epoch, uids):
+    """NaN-poison the slots whose (round, uid) matches the plan.
+
+    ``trained``: per-slot trained param trees ``{k: [S, ...]}``;
+    ``table``: the int32 ``[N, 2]`` (round, uid) plan
+    (:func:`~heterofl_tpu.chaos.resolve_poison_cfg`); ``epoch``: the
+    round's traced epoch scalar; ``uids``: the raw per-slot global user
+    ids (``-1`` padding never matches a uid >= 0).  Adds ``NaN`` to every
+    element of a matched slot's trees -- the poison flows through the
+    quarantine gate (or, un-gated, through the psum into the globals,
+    which is the watchdog-rollback drill's trigger)."""
+    rounds = jnp.asarray(table[:, 0])
+    targets = jnp.asarray(table[:, 1])
+    hit = jnp.any((rounds[None, :] == epoch)
+                  & (targets[None, :] == uids[:, None]), axis=1)
+    bad = jnp.where(hit, jnp.float32(jnp.nan), jnp.float32(0.0))
+
+    def bend(v):
+        return v + bad.reshape((-1,) + (1,) * (v.ndim - 1)).astype(v.dtype)
+
+    return {k: bend(v) for k, v in trained.items()}

@@ -299,10 +299,40 @@ DEFAULT_CFG: Dict[str, Any] = {
     # grid spec into arm batches x structural launches.
     "arms": None,
     # watchdog knobs (telemetry='on' enables it at warn defaults): a dict
-    # {"action": "warn"|"abort"|"off", "spike_factor": 3.0, "window": 8} --
-    # non-finite params and loss-spikes-vs-rolling-median trip at fetch
-    # boundaries with a loud warning ("warn") or a WatchdogError ("abort").
+    # {"action": "warn"|"abort"|"rollback"|"off", "spike_factor": 3.0,
+    # "window": 8, "max_retries": 3, "backoff": 0.5} -- non-finite params
+    # and loss-spikes-vs-rolling-median trip at fetch boundaries with a
+    # loud warning ("warn"), a WatchdogError ("abort"), or an automatic
+    # rollback (ISSUE 15): restore the newest finite-verifying checkpoint
+    # generation, fold a retry salt into the round key stream (the
+    # replayed superstep draws a FRESH cohort), retry up to max_retries
+    # times with exponential backoff seconds, then escalate to abort.
     "watchdog": None,
+    # in-program client-update quarantine (ISSUE 15 tentpole): a per-client
+    # finiteness (+ optional update-norm) gate computed inside the fused
+    # round from values each device already holds, folded into BOTH the
+    # sums and the counts BEFORE the single global psum -- a NaN-poisoned
+    # (or norm-exploded) client becomes a zero-count participant and the
+    # globals never see its update.  "off" (default) keeps every program
+    # bit-identical to the pre-quarantine engines; "on" gates on
+    # finiteness only (bit-identical outputs when every update is clean);
+    # a dict {"max_norm": R} additionally quarantines updates whose
+    # masked L2 norm exceeds R.  The quarantined-client count rides the
+    # metrics pytree as the obs_quarantine probe (zero new collectives,
+    # same one-psum/wire budgets -- staticcheck quarantine variants).
+    "quarantine": "off",
+    # checkpoint generations (ISSUE 15): how many rotated checkpoint
+    # generations to retain ({tag}_checkpoint.pkl, .g1, .g2, ...).  Every
+    # write is fsync-before-rename with a SHA-256 content checksum;
+    # resume/rollback fall back generation-by-generation to the newest
+    # verifying blob.
+    "checkpoint_keep": 3,
+    # chaos fault injection (ISSUE 15, heterofl_tpu/chaos/): a list of
+    # [round, uid] pairs whose client updates are NaN-poisoned IN-PROGRAM
+    # after local training, before aggregation -- the deterministic
+    # poisoned-client model the chaos drill and the quarantine/rollback
+    # tests exercise.  None (default) leaves every program untouched.
+    "chaos_poison": None,
     # run tracing (obs/trace.py): a directory to write a Chrome-trace-event
     # trace.json (PhaseTimer phases + driver events + jax.profiler
     # annotations; open in Perfetto) and a schema'd events.jsonl per run.
@@ -527,10 +557,21 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
     resolve_eval_cohort(cfg)
     # telemetry/ledger validation (ISSUE 10/12): unknown modes/watchdog
     # knobs fail here, never as a silent telemetry-off fallback mid-run
-    from .obs import resolve_ledger_cfg, resolve_telemetry_cfg
+    from .obs import (resolve_ledger_cfg, resolve_quarantine_cfg,
+                      resolve_telemetry_cfg)
 
     resolve_telemetry_cfg(cfg)
     resolve_ledger_cfg(cfg)
+    # fault-tolerance validation (ISSUE 15): quarantine modes, checkpoint
+    # generation counts and chaos poison tables fail here, at config time
+    # (chaos/ is import-light like sched/ and obs/; checkpoint_keep lives
+    # here -- utils.checkpoint imports jax, and this module's jax-free
+    # import contract must hold for offline tooling)
+    resolve_quarantine_cfg(cfg)
+    resolve_checkpoint_keep(cfg)
+    from .chaos import resolve_poison_cfg
+
+    resolve_poison_cfg(cfg)
     # arms validation (ISSUE 14): malformed counts/seed vectors fail HERE,
     # never as a silent single-arm fallback mid-run (multi/ is import-light
     # like sched/ and obs/)
@@ -553,6 +594,21 @@ def resolve_prefetch_depth(cfg: Dict[str, Any]) -> int:
         raise ValueError(f"Not valid stream_prefetch_depth: {depth!r} "
                          f"(an int >= 1)")
     return depth
+
+
+def resolve_checkpoint_keep(cfg: Dict[str, Any]) -> int:
+    """Validate ``cfg['checkpoint_keep']`` and return it (ISSUE 15).  THE
+    one validator: process_control applies it and the driver re-applies it
+    -- a malformed value fails loudly at config time, never as a silent
+    single-generation fallback mid-run.  Lives here (not in
+    utils.checkpoint) to keep this module's jax-free import contract."""
+    keep = cfg.get("checkpoint_keep", 3)
+    if keep is None:
+        return 3
+    if not isinstance(keep, int) or isinstance(keep, bool) or keep < 1:
+        raise ValueError(f"Not valid checkpoint_keep: {keep!r} (an int >= 1 "
+                         f"checkpoint generations to retain)")
+    return keep
 
 
 def resolve_eval_cohort(cfg: Dict[str, Any]):

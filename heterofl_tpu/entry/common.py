@@ -25,10 +25,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config as C
+from ..chaos import resolve_poison_cfg
 from ..compress import resolve_codec_cfg
-from ..obs import resolve_ledger_cfg, resolve_telemetry_cfg, split_probes
+from ..obs import (resolve_ledger_cfg, resolve_quarantine_cfg,
+                   resolve_telemetry_cfg, split_probes)
 from ..obs.ledger import ClientLedger
-from ..obs.watchdog import Watchdog, WatchdogError
+from ..obs.watchdog import (RETRY_SALT, Watchdog, WatchdogError,
+                            WatchdogRollback)
 from ..data import (
     bptt_windows,
     stack_windows,
@@ -435,6 +438,31 @@ class FedExperiment:
             if (self.obs_spec.probes and self.obs_spec.watchdog is not None) \
             else None
         self.tracer = None  # obs.trace.TraceRecorder, built in run()
+        # client-update quarantine (ISSUE 15): validated loudly here so a
+        # quarantine config that cannot run fails at construction.  The
+        # gate lives in the engines' round cores -- the sliced debug twin
+        # replays the reference host loop and has no core to gate in.
+        self.quarantine = resolve_quarantine_cfg(cfg)
+        if self.quarantine.enabled and cfg.get("strategy") == "sliced":
+            raise ValueError(
+                "quarantine needs a mesh-native strategy ('masked' or "
+                "'grouped'): the sliced debug twin replays the reference "
+                "host loop and has no in-program round core to gate")
+        if resolve_poison_cfg(cfg) is not None \
+                and cfg.get("strategy") == "sliced":
+            raise ValueError(
+                "chaos_poison needs a mesh-native strategy ('masked' or "
+                "'grouped'): the sliced debug twin has no in-program "
+                "update to poison")
+        # durable generational checkpoints (ISSUE 15): rotation depth
+        self.checkpoint_keep = C.resolve_checkpoint_keep(cfg)
+        # rollback budget bookkeeping (watchdog action='rollback'):
+        # attempts since the last CLEAN checkpoint write -- a completed
+        # superstep + checkpoint proves recovery, resetting the budget
+        self._rollback_attempts = 0
+        # chaos fault injector (heterofl_tpu/chaos/): attached by the
+        # drill harness; None (always, outside drills) = zero-cost checks
+        self.chaos = None
         # population-observatory ledger (ISSUE 12, obs/ledger.py): a
         # host-side per-client record updated O(active) at each metrics
         # fetch -- never a program change, so it composes with every
@@ -625,7 +653,15 @@ class FedExperiment:
                                           avail=self.sched_spec.avail_row(epoch),
                                           sampler=self.sampler_spec.kind))
 
+    def _chaos(self, point: str) -> None:
+        """Chaos kill check (ISSUE 15, heterofl_tpu/chaos/): raises
+        ChaosKill when an attached drill plan schedules a death at this
+        boundary; no-op (one attribute test) outside drills."""
+        if self.chaos is not None:
+            self.chaos.check(point)
+
     def train_round(self, params, epoch: int, lr: float, logger: Logger):
+        self._chaos("superstep")  # the K=1 dispatch boundary
         user_idx = self.sample_users(epoch)
         key = jax.random.fold_in(self.host_key, epoch)
         t0 = time.time()
@@ -652,7 +688,9 @@ class FedExperiment:
                 pending = PendingMetrics(ms)
         else:
             params, ms = self.engine.train_round(params, key, lr, user_idx,
-                                                 self.train_data, timer=self.phase_timer)
+                                                 self.train_data,
+                                                 timer=self.phase_timer,
+                                                 epoch=epoch)
             pending = PendingMetrics(ms)
         if profiling:
             jax.block_until_ready(params)
@@ -662,6 +700,7 @@ class FedExperiment:
         # it cannot be re-drawn at fetch time like the superstep streams
         tag = {"epoch": epoch, "lr": lr, "dt": 0.0, "phases": {},
                "uids": user_idx}
+        self._chaos("fetch")
         with self.phase_timer.phase("fetch"):
             due = self.metrics_pipe.push(tag, pending)
         # dt and the phase breakdown are filled in AFTER the push (the tag is
@@ -772,6 +811,7 @@ class FedExperiment:
         copy, so staging ahead can never corrupt an in-flight superstep."""
         if not self.stream_prefetch:
             return
+        self._chaos("prefetch")
         n_rounds = self.cfg["num_epochs"]["global"]
         e = (self._next_cohorts[-1][0] + self._next_cohorts[-1][1]
              if self._next_cohorts else epoch0)
@@ -874,6 +914,7 @@ class FedExperiment:
         eval results come back in the same per-superstep fetch, and the last
         per-eval-window host round-trip is gone -- ``eval_interval`` no
         longer clamps K."""
+        self._chaos("superstep")
         cfg = self.cfg
         n_rounds = cfg["num_epochs"]["global"]
         mask = tuple((epoch0 + r) % self.eval_interval == 0
@@ -929,6 +970,7 @@ class FedExperiment:
         tag = {"kind": "superstep", "epoch0": epoch0, "k": k, "dt": 0.0,
                "phases": {},
                "lrs": [self.scheduler(epoch0 + r) for r in range(k)]}
+        self._chaos("fetch")
         with self.phase_timer.phase("fetch"):
             due = self.metrics_pipe.push(tag, pending)
         # dt/phases fill in AFTER the push (the tag object rides the
@@ -980,6 +1022,18 @@ class FedExperiment:
             try:
                 self.watchdog.check(epoch, probes=probes, loss=loss,
                                     emit=emit_trip)
+            except WatchdogRollback:
+                # rollback durability (ISSUE 15 satellite): the SAME
+                # artifacts as the abort path, per recovery attempt -- the
+                # trip instant is the last event on disk before the
+                # rollback unwinds -- but via sync(), not close(): the run
+                # continues tracing through the recovery
+                if self.tracer is not None:
+                    self.tracer.sync()
+                logger.flush()
+                if self.ledger is not None and jax.process_index() == 0:
+                    self.ledger.save(self._ledger_path())
+                raise
             except WatchdogError:
                 # durability (ISSUE 12 satellite): the evidence must be ON
                 # DISK before the abort unwinds -- close() fsyncs
@@ -1108,7 +1162,10 @@ class FedExperiment:
         here, at the fetch boundary.  ``uids``: the K=1 path's drawn cohort
         (rides the tag) -- its ledger fold happens here, at the same fetch
         boundary the superstep path folds at."""
-        if probes is None and self.obs_spec.probes:
+        if probes is None and (self.obs_spec.probes
+                               or self.quarantine.enabled):
+            # the quarantine counter rides as an obs_ probe even with
+            # telemetry off (ISSUE 15) -- split either way
             ms, plist = split_probes(ms, self.mesh.shape["clients"])
             if plist:
                 probes = plist[0]
@@ -1267,98 +1324,255 @@ class FedExperiment:
                 # 12): written on every exit path, aborts included
                 self.ledger.save(self._ledger_path())
 
+    @staticmethod
+    def _tree_finite(tree) -> bool:
+        """True iff every float array leaf of a nested dict/list tree is
+        all-finite (non-array / non-float leaves pass)."""
+        if isinstance(tree, dict):
+            return all(FedExperiment._tree_finite(v) for v in tree.values())
+        if isinstance(tree, (list, tuple)):
+            return all(FedExperiment._tree_finite(v) for v in tree)
+        try:
+            arr = np.asarray(tree)
+        except Exception:
+            return True
+        if not np.issubdtype(arr.dtype, np.floating):
+            return True
+        return bool(np.all(np.isfinite(arr)))
+
+    def _load_rollback_blob(self) -> Optional[Dict[str, Any]]:
+        """The newest checkpoint generation that BOTH verifies (checksum)
+        and holds all-finite restorable state (ISSUE 15): under a deferred
+        metrics fetch the newest generation can checksum clean yet carry
+        the very NaN the watchdog tripped on -- in the params, OR in a
+        restored carry (the EF residual, the buffered staleness buffer,
+        the sBN state).  Restoring such a blob would trip again
+        immediately and burn the whole retry budget on one poisoned blob.
+        Returns None when no usable generation exists (fresh restart)."""
+        from ..utils.checkpoint import iter_verified_generations
+
+        path = checkpoint_path(self.cfg["output_dir"], self.tag)
+        for p, blob in iter_verified_generations(path):
+            finite = all(
+                self._tree_finite(blob.get(k))
+                for k in ("params", "bn_state", "wire_resid", "sched_buf"))
+            if finite:
+                return blob
+            warnings.warn(f"rollback: checkpoint generation {p} verifies "
+                          f"but holds non-finite params or carries; "
+                          f"falling back a generation")
+        return None
+
+    def _recover_rollback(self, logger: Logger, trip: WatchdogRollback,
+                          pivot_mode: str):
+        """One watchdog-rollback recovery attempt (ISSUE 15): emit the
+        recovery evidence, drop every piece of in-flight state, salt the
+        round key stream (the replayed superstep draws a FRESH cohort),
+        restore the newest usable checkpoint generation (or restart fresh
+        when none exists), back off, and hand (params, epoch, pivot) back
+        to the run loop.  Escalates to :class:`WatchdogError` -- with the
+        abort path's durability -- once ``max_retries`` is spent."""
+        spec = self.obs_spec.watchdog
+        self._rollback_attempts += 1
+        attempt = self._rollback_attempts
+        if attempt > spec.max_retries:
+            if self.tracer is not None:
+                self.tracer.close()
+            logger.flush()
+            if self.ledger is not None and jax.process_index() == 0:
+                self.ledger.save(self._ledger_path())
+            raise WatchdogError(
+                f"watchdog rollback budget spent ({spec.max_retries} "
+                f"attempt(s)): escalating to abort; last trip "
+                f"{trip.events[0] if trip.events else trip!r}") from trip
+        # the retry salt: every replayed round re-derives its keys from the
+        # salted stream, so the re-drawn cohort excludes the poisoned draw
+        # deterministically (chaos.drill predicts these draws)
+        self.host_key = jax.random.fold_in(self.host_key,
+                                           RETRY_SALT + attempt)
+        blob = self._load_rollback_blob()
+        rec = {"event": "rollback", "attempt": attempt,
+               "max_retries": spec.max_retries,
+               "kind": trip.events[0].get("kind") if trip.events else None,
+               "trip_epoch": trip.events[0].get("epoch")
+               if trip.events else None,
+               "restored_epoch": (blob or {}).get("epoch"),
+               "fresh_restart": blob is None}
+        logger.emit(rec, tag="recovery")
+        if self.tracer is not None:
+            self.tracer.instant("recovery", cat="obs", args=rec)
+        warnings.warn(f"watchdog rollback attempt {attempt}/"
+                      f"{spec.max_retries}: restoring "
+                      f"{'a fresh init' if blob is None else 'epoch %s' % rec['restored_epoch']} "
+                      f"with a salted cohort stream")
+        logger.safe(False)  # close the aborted iteration's writer
+        # drop EVERY piece of in-flight state the unwound iteration left:
+        # pending metric fetches (discarded -- their rounds replay),
+        # prefetched cohorts (drawn pre-salt), commitment counters, the
+        # spike window, and the engines' device scan carries
+        try:
+            self.metrics_pipe.flush()
+        except Exception:
+            pass  # a poisoned pending fetch must not block recovery
+        self._next_cohorts = []
+        self._ss_dispatched = self._ss_fetched = 0
+        if self._commitment is not None:
+            self._commitment = ScheduleCommitment(self.sampler_spec.horizon)
+        if self.watchdog is not None:
+            self.watchdog.reset_window()
+        self._codec_engine().reset_carries()
+        pivot0 = -float("inf") if pivot_mode == "max" else float("inf")
+        if blob is None:
+            params = self.model.init(jax.random.fold_in(self.host_key, 0))
+            logger.load_state_dict({})
+            logger.reset()
+            self.scheduler = make_scheduler(self.cfg)
+            if self.ledger is not None:
+                self.ledger = ClientLedger(
+                    self.cfg["num_users"],
+                    sorted({float(r) for r in self.cfg["model_rate"]},
+                           reverse=True))
+            self.bn_state = {}
+            epoch, pivot = 1, pivot0
+        else:
+            params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+            if blob.get("wire_resid") is not None:
+                self._codec_engine().set_wire_resid(blob["wire_resid"])
+            if blob.get("sched_buf") is not None:
+                self._codec_engine().set_sched_buf(blob["sched_buf"])
+            if blob.get("ledger") is not None and self.ledger is not None:
+                self.ledger.load_state_dict(blob["ledger"])
+            logger.load_state_dict(blob.get("logger_state") or {})
+            if blob.get("scheduler_state") \
+                    and hasattr(self.scheduler, "load_state_dict"):
+                self.scheduler.load_state_dict(blob["scheduler_state"])
+            self.bn_state = blob.get("bn_state", {})
+            epoch = blob.get("epoch", 1)
+            pivot = blob.get("pivot", pivot0)
+        if spec.backoff > 0:
+            time.sleep(min(spec.backoff * (2 ** (attempt - 1)), 30.0))
+        return params, epoch, pivot
+
     def _run_loop(self, logger, pivot_metric, pivot_mode, pivot, epoch,
                   n_rounds, eval_interval, data_split, label_split, params):
         cfg = self.cfg
-        while epoch <= n_rounds:
-            logger.safe(True)
-            # superstep length: the end of the run is the ONLY clamp left --
-            # eval windows run inside the scan (ISSUE 4), so K no longer
-            # shortens to the next eval boundary.  Checkpoints land on
-            # superstep boundaries; evals inside a superstep are logged (and
-            # feed Plateau) when its metrics are fetched.
-            k_eff = 1
-            if self.superstep_rounds > 1 or self.streaming:
-                # streaming always takes the superstep path (k_eff=1 at
-                # superstep_rounds=1): cohorts ride the scanned program's
-                # xs, so there is exactly one store-backed dispatch shape
-                k_eff = min(self.superstep_rounds, n_rounds - epoch + 1)
-                # a clamped end-of-run tail still goes through the superstep
-                # path (smaller k) so ONE sampling stream covers the run
-                with self._trace_span("superstep",
-                                      {"epoch0": int(epoch), "k": int(k_eff)}):
-                    params = self.train_superstep(params, epoch, k_eff, logger)
-                epoch = epoch + k_eff - 1  # last round this iteration covered
-                # pivot integrity: the checkpoint below holds END-OF-SUPERSTEP
-                # params, so only an eval on the boundary round -- fetched
-                # synchronously, i.e. logged THIS iteration -- may update the
-                # best-copy pivot; mid-superstep evals log and feed Plateau
-                # but their params were consumed inside the scan
-                pivot_fresh = (self.metrics_pipe.fetch_every == 1
-                               and (epoch % eval_interval == 0
-                                    or epoch == n_rounds))
-            else:
-                pivot_fresh = True
-                lr = self.scheduler(epoch)
-                with self._trace_span("round", {"epoch": int(epoch)}):
-                    params = self.train_round(params, epoch, lr, logger)
-                evaluated = epoch % eval_interval == 0 or epoch == n_rounds
-                if evaluated:
-                    with self._trace_span("eval", {"epoch": int(epoch)}):
-                        self.evaluate(params, epoch, logger, label_split)
-                    if isinstance(self.scheduler, PlateauScheduler):
-                        # min-mode plateau fed the test Global loss, only on
-                        # rounds that actually evaluated.  (The reference
-                        # feeds logger.mean['train/Global-Accuracy'], a key
-                        # its train loop never writes, i.e. a constant 0 --
-                        # an upstream bug we do not reproduce.)
-                        self.scheduler.step_metric(
-                            logger.mean.get("test/Global-Loss", 0.0))
-            logger.safe(False)
-            cur = logger.history.get(f"test/{pivot_metric}", [None])[-1]
-            is_best = pivot_fresh and cur is not None \
-                and (cur > pivot if pivot_mode == "max" else cur < pivot)
-            if is_best:
-                pivot = cur  # update BEFORE saving so a resumed run keeps it
-            blob_out = {
-                "cfg": {k: v for k, v in cfg.items() if k != "vocab"},
-                "epoch": epoch + 1,
-                "data_split": data_split,
-                "label_split": label_split,
-                "params": params,
-                "bn_state": getattr(self, "bn_state", {}),
-                # the error-feedback residual carry at this superstep
-                # boundary (ISSUE 8; None under the dense codec)
-                "wire_resid": (self._codec_engine().wire_resid_host()
-                               if self.wire_codec != "dense" else None),
-                # the buffered-async staleness carry at this superstep
-                # boundary (ISSUE 9; None under sync aggregation)
-                "sched_buf": (self._codec_engine().sched_buf_host()
-                              if self.sched_spec.buffered else None),
-                # the population ledger at this superstep boundary (ISSUE
-                # 12; None when ledger='off')
-                "ledger": (self.ledger.state_dict()
-                           if self.ledger is not None else None),
-                "pivot": pivot,
-                "logger_history": dict(logger.history),
-                "logger_state": logger.state_dict(),
-                "scheduler_state": self.scheduler.state_dict()
-                if hasattr(self.scheduler, "state_dict") else None,
-            }
-            # multi-host: params/metrics are replicated, so only process 0
-            # writes (every host writing the same file corrupts shared
-            # filesystems; harmless no-op on a single host)
-            if jax.process_index() == 0:
-                with self._trace_span("checkpoint", {"epoch": int(epoch)}):
-                    save_checkpoint(checkpoint_path(cfg["output_dir"], self.tag),
-                                    blob_out)
-                    if is_best:
-                        copy_best(cfg["output_dir"], self.tag)
-            logger.reset()
-            epoch += 1
-        self._drain_metrics(logger)  # safety: nothing stays on device at exit
+        while True:
+            try:
+                if epoch > n_rounds:
+                    # the final drain sits INSIDE the recovery loop: under
+                    # a deferred fetch the last superstep's trip surfaces
+                    # here, and a rollback must restore + re-enter the
+                    # round loop instead of degrading to an abort
+                    self._drain_metrics(logger)  # nothing stays on device
+                    break
+                params, epoch, pivot = self._run_iteration(
+                    logger, pivot_metric, pivot_mode, pivot, epoch, n_rounds,
+                    eval_interval, data_split, label_split, params)
+            except WatchdogRollback as trip:
+                # watchdog auto-rollback (ISSUE 15): restore, salt, retry
+                params, epoch, pivot = self._recover_rollback(
+                    logger, trip, pivot_mode)
         return {"params": params, "bn_state": getattr(self, "bn_state", {}),
                 "logger": logger, "data_split": data_split, "label_split": label_split}
+
+    def _run_iteration(self, logger, pivot_metric, pivot_mode, pivot, epoch,
+                       n_rounds, eval_interval, data_split, label_split,
+                       params):
+        """One run-loop iteration: a dispatch window (superstep or K=1
+        round + eval), the best-pivot decision, and the durable checkpoint
+        write.  Returns ``(params, next_epoch, pivot)``; raises
+        :class:`WatchdogRollback` through to :meth:`_run_loop` when the
+        watchdog trips under ``action='rollback'``."""
+        cfg = self.cfg
+        logger.safe(True)
+        # superstep length: the end of the run is the ONLY clamp left --
+        # eval windows run inside the scan (ISSUE 4), so K no longer
+        # shortens to the next eval boundary.  Checkpoints land on
+        # superstep boundaries; evals inside a superstep are logged (and
+        # feed Plateau) when its metrics are fetched.
+        k_eff = 1
+        if self.superstep_rounds > 1 or self.streaming:
+            # streaming always takes the superstep path (k_eff=1 at
+            # superstep_rounds=1): cohorts ride the scanned program's
+            # xs, so there is exactly one store-backed dispatch shape
+            k_eff = min(self.superstep_rounds, n_rounds - epoch + 1)
+            # a clamped end-of-run tail still goes through the superstep
+            # path (smaller k) so ONE sampling stream covers the run
+            with self._trace_span("superstep",
+                                  {"epoch0": int(epoch), "k": int(k_eff)}):
+                params = self.train_superstep(params, epoch, k_eff, logger)
+            epoch = epoch + k_eff - 1  # last round this iteration covered
+            # pivot integrity: the checkpoint below holds END-OF-SUPERSTEP
+            # params, so only an eval on the boundary round -- fetched
+            # synchronously, i.e. logged THIS iteration -- may update the
+            # best-copy pivot; mid-superstep evals log and feed Plateau
+            # but their params were consumed inside the scan
+            pivot_fresh = (self.metrics_pipe.fetch_every == 1
+                           and (epoch % eval_interval == 0
+                                or epoch == n_rounds))
+        else:
+            pivot_fresh = True
+            lr = self.scheduler(epoch)
+            with self._trace_span("round", {"epoch": int(epoch)}):
+                params = self.train_round(params, epoch, lr, logger)
+            evaluated = epoch % eval_interval == 0 or epoch == n_rounds
+            if evaluated:
+                with self._trace_span("eval", {"epoch": int(epoch)}):
+                    self.evaluate(params, epoch, logger, label_split)
+                if isinstance(self.scheduler, PlateauScheduler):
+                    # min-mode plateau fed the test Global loss, only on
+                    # rounds that actually evaluated.  (The reference
+                    # feeds logger.mean['train/Global-Accuracy'], a key
+                    # its train loop never writes, i.e. a constant 0 --
+                    # an upstream bug we do not reproduce.)
+                    self.scheduler.step_metric(
+                        logger.mean.get("test/Global-Loss", 0.0))
+        logger.safe(False)
+        cur = logger.history.get(f"test/{pivot_metric}", [None])[-1]
+        is_best = pivot_fresh and cur is not None \
+            and (cur > pivot if pivot_mode == "max" else cur < pivot)
+        if is_best:
+            pivot = cur  # update BEFORE saving so a resumed run keeps it
+        blob_out = {
+            "cfg": {k: v for k, v in cfg.items() if k != "vocab"},
+            "epoch": epoch + 1,
+            "data_split": data_split,
+            "label_split": label_split,
+            "params": params,
+            "bn_state": getattr(self, "bn_state", {}),
+            # the error-feedback residual carry at this superstep
+            # boundary (ISSUE 8; None under the dense codec)
+            "wire_resid": (self._codec_engine().wire_resid_host()
+                           if self.wire_codec != "dense" else None),
+            # the buffered-async staleness carry at this superstep
+            # boundary (ISSUE 9; None under sync aggregation)
+            "sched_buf": (self._codec_engine().sched_buf_host()
+                          if self.sched_spec.buffered else None),
+            # the population ledger at this superstep boundary (ISSUE
+            # 12; None when ledger='off')
+            "ledger": (self.ledger.state_dict()
+                       if self.ledger is not None else None),
+            "pivot": pivot,
+            "logger_history": dict(logger.history),
+            "logger_state": logger.state_dict(),
+            "scheduler_state": self.scheduler.state_dict()
+            if hasattr(self.scheduler, "state_dict") else None,
+        }
+        # multi-host: params/metrics are replicated, so only process 0
+        # writes (every host writing the same file corrupts shared
+        # filesystems; harmless no-op on a single host)
+        if jax.process_index() == 0:
+            self._chaos("checkpoint")
+            with self._trace_span("checkpoint", {"epoch": int(epoch)}):
+                save_checkpoint(checkpoint_path(cfg["output_dir"], self.tag),
+                                blob_out, keep=self.checkpoint_keep)
+                if is_best:
+                    copy_best(cfg["output_dir"], self.tag)
+        logger.reset()
+        # a clean iteration ending in a durable checkpoint proves recovery:
+        # the rollback budget re-arms for the next (independent) incident
+        self._rollback_attempts = 0
+        return params, epoch + 1, pivot
 
 
 class ArmsExperiment(FedExperiment):
@@ -1398,6 +1612,13 @@ class ArmsExperiment(FedExperiment):
         self._arm_watchdogs = ([Watchdog(self.obs_spec.watchdog)
                                 for _ in range(self.arms_spec.count)]
                                if self.watchdog is not None else None)
+        if self.obs_spec.watchdog is not None \
+                and self.obs_spec.watchdog.action == "rollback":
+            raise ValueError(
+                "watchdog action='rollback' cannot combine with arms yet: "
+                "one arm's trip would roll every arm back, and the "
+                "multiplexed loop has no per-arm recovery (a ROADMAP "
+                "follow-on); use 'warn'/'abort' for arms runs")
         self._staged_lr_vec = None  # the [E] LR vector of the live dispatch
 
     def _arms_tag(self) -> str:
@@ -1575,7 +1796,7 @@ class ArmsExperiment(FedExperiment):
                 if jax.process_index() == 0:
                     save_checkpoint(
                         checkpoint_path(cfg["output_dir"], self._arm_tag(e)),
-                        arm_blob)
+                        arm_blob, keep=self.checkpoint_keep)
                     if is_best:
                         copy_best(cfg["output_dir"], self._arm_tag(e))
             # the multiplexed resume blob: stacked params + per-arm state
@@ -1591,7 +1812,7 @@ class ArmsExperiment(FedExperiment):
             }
             if jax.process_index() == 0:
                 save_checkpoint(checkpoint_path(cfg["output_dir"], tag),
-                                blob_out)
+                                blob_out, keep=self.checkpoint_keep)
             logger.safe(False)
             epoch = epoch_end + 1
         return {"params": params, "arms": self.arms_spec, "pivots": pivots,

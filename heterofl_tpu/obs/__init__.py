@@ -54,8 +54,18 @@ TELEMETRY_MODES = ("off", "on", "hist")
 
 #: watchdog reactions (cfg['watchdog']['action']): 'warn' (default) emits
 #: a loud warning + structured obs event, 'abort' raises WatchdogError at
-#: the fetch boundary, 'off' disables the watchdog while keeping probes
-WATCHDOG_ACTIONS = ("warn", "abort", "off")
+#: the fetch boundary, 'rollback' (ISSUE 15) raises WatchdogRollback --
+#: the driver restores the newest verifying checkpoint generation, salts
+#: the round key stream and retries with bounded attempts + backoff,
+#: escalating to abort when the budget is spent -- 'off' disables the
+#: watchdog while keeping probes
+WATCHDOG_ACTIONS = ("warn", "abort", "rollback", "off")
+
+#: rollback budget defaults (cfg['watchdog']['max_retries'/'backoff']):
+#: attempts before escalating to abort, and the base of the exponential
+#: backoff in seconds (attempt n sleeps backoff * 2**(n-1))
+DEFAULT_MAX_RETRIES = 3
+DEFAULT_BACKOFF = 0.5
 
 #: default loss-spike threshold: loss > factor x rolling median trips
 DEFAULT_SPIKE_FACTOR = 3.0
@@ -67,9 +77,11 @@ DEFAULT_SPIKE_WINDOW = 8
 #: fetch-side split (``split_probes``) and every assemble path key on it
 PROBE_PREFIX = "obs_"
 
-#: the finished per-round probe record's fields (the order is the schema)
+#: the finished per-round probe record's fields (the order is the schema).
+#: ``quarantined`` (ISSUE 15) is present exactly when quarantine is on --
+#: the count of clients whose update the in-program gate zeroed out.
 PROBE_FIELDS = ("update_norm", "grad_norm", "participation", "resid_norm",
-                "stale_norm", "nonfinite")
+                "stale_norm", "nonfinite", "quarantined")
 
 #: the finished cohort-histogram fields of a telemetry='hist' record
 #: (ISSUE 12; each a list of bucket counts -- see obs/hist.py for edges)
@@ -88,14 +100,62 @@ LEDGER_MODES = ("off", "on")
 class WatchdogSpec:
     """Resolved watchdog knobs (one immutable object, the ScheduleSpec
     convention).  ``spike_factor=None`` disables the loss-spike detector
-    while keeping the non-finite check."""
+    while keeping the non-finite check.  ``max_retries``/``backoff`` only
+    matter under ``action='rollback'`` (ISSUE 15): the recovery budget and
+    the exponential-backoff base in seconds."""
 
     def __init__(self, action: str = "warn",
                  spike_factor: Optional[float] = DEFAULT_SPIKE_FACTOR,
-                 window: int = DEFAULT_SPIKE_WINDOW):
+                 window: int = DEFAULT_SPIKE_WINDOW,
+                 max_retries: int = DEFAULT_MAX_RETRIES,
+                 backoff: float = DEFAULT_BACKOFF):
         self.action = action
         self.spike_factor = spike_factor
         self.window = window
+        self.max_retries = max_retries
+        self.backoff = backoff
+
+
+class QuarantineSpec:
+    """The resolved client-update quarantine configuration (ISSUE 15):
+    engines read ``enabled``/``max_norm`` at construction.  Built by
+    :func:`resolve_quarantine_cfg` -- there is no second parser."""
+
+    def __init__(self, enabled: bool = False,
+                 max_norm: Optional[float] = None):
+        self.enabled = enabled
+        self.max_norm = max_norm
+
+
+def resolve_quarantine_cfg(cfg: Dict[str, Any]) -> QuarantineSpec:
+    """Validate ``cfg['quarantine']`` and return the :class:`QuarantineSpec`.
+
+    THE one validator (the PR 6/8/9 convention): an unknown mode or a
+    malformed ``max_norm`` fails loudly at config time, never as a silent
+    quarantine-off fallback mid-run.  ``'off'``/None = disabled (every
+    program bit-identical to pre-quarantine); ``'on'`` = finiteness gate
+    only; ``{'max_norm': R}`` additionally quarantines updates whose
+    masked L2 norm exceeds ``R`` (R > 0)."""
+    raw = cfg.get("quarantine", "off")
+    if raw is None or raw == "off":
+        return QuarantineSpec()
+    if raw == "on":
+        return QuarantineSpec(enabled=True)
+    if isinstance(raw, dict):
+        unknown = set(raw) - {"max_norm"}
+        if unknown:
+            raise ValueError(f"Not valid quarantine keys: {sorted(unknown)} "
+                             f"(max_norm)")
+        mn = raw.get("max_norm")
+        if mn is not None and (not isinstance(mn, (int, float))
+                               or isinstance(mn, bool) or float(mn) <= 0.0):
+            raise ValueError(f"Not valid quarantine max_norm: {mn!r} (a "
+                             f"positive update-norm bound, or None for the "
+                             f"finiteness-only gate)")
+        return QuarantineSpec(enabled=True,
+                              max_norm=None if mn is None else float(mn))
+    raise ValueError(f"Not valid quarantine: {raw!r} ('off', 'on' or a "
+                     f"{{'max_norm': R}} dict)")
 
 
 class TelemetrySpec:
@@ -158,10 +218,12 @@ def resolve_telemetry_cfg(cfg: Dict[str, Any]) -> TelemetrySpec:
     watchdog: Optional[WatchdogSpec] = None
     if mode != "off":
         wd = dict(raw_wd or {})
-        unknown = set(wd) - {"action", "spike_factor", "window"}
+        unknown = set(wd) - {"action", "spike_factor", "window",
+                             "max_retries", "backoff"}
         if unknown:
             raise ValueError(f"Not valid watchdog keys: {sorted(unknown)} "
-                             f"(action/spike_factor/window)")
+                             f"(action/spike_factor/window/max_retries/"
+                             f"backoff)")
         action = wd.get("action", "warn") or "warn"
         if action not in WATCHDOG_ACTIONS:
             raise ValueError(f"Not valid watchdog action: {action!r} "
@@ -178,11 +240,25 @@ def resolve_telemetry_cfg(cfg: Dict[str, Any]) -> TelemetrySpec:
             raise ValueError(f"Not valid watchdog window: {window!r} "
                              f"(an int >= 2, the rolling-median horizon in "
                              f"rounds)")
+        retries = wd.get("max_retries", DEFAULT_MAX_RETRIES)
+        if not isinstance(retries, int) or isinstance(retries, bool) \
+                or retries < 1:
+            raise ValueError(f"Not valid watchdog max_retries: {retries!r} "
+                             f"(an int >= 1 rollback attempts before "
+                             f"escalating to abort)")
+        backoff = wd.get("backoff", DEFAULT_BACKOFF)
+        if not isinstance(backoff, (int, float)) or isinstance(backoff, bool) \
+                or float(backoff) < 0.0:
+            raise ValueError(f"Not valid watchdog backoff: {backoff!r} (a "
+                             f"non-negative exponential-backoff base in "
+                             f"seconds)")
         if action != "off":
             watchdog = WatchdogSpec(action=action,
                                     spike_factor=None if sf is None
                                     else float(sf),
-                                    window=window)
+                                    window=window,
+                                    max_retries=retries,
+                                    backoff=float(backoff))
     trace_dir = cfg.get("trace_dir")
     if trace_dir is not None and not isinstance(trace_dir, str):
         raise ValueError(f"Not valid trace_dir: {trace_dir!r} (a directory "
@@ -237,6 +313,11 @@ def split_probes(ms: Dict[str, Any], n_dev: int, layout: str = "flat",
                 rec[base] = [float(c) for c in row]
             elif base == "resid_sq":
                 rec["resid_norm"] = float(np.sqrt(x.sum()))
+            elif base == "quarantine":
+                # quarantined-client count (ISSUE 15): per-device partials
+                # (each device counts its own gated slots) sum across
+                # devices -- and across levels on the grouped span layout
+                rec["quarantined"] = int(round(float(x.sum())))
             elif base == "nonfinite":
                 rec["nonfinite"] = int(x[0, 0])
             elif base.endswith("_sq"):

@@ -36,6 +36,44 @@ def _sq_norm(tree: Dict[str, jnp.ndarray]) -> jnp.ndarray:
     return sum(jnp.sum(jnp.square(v)) for v in tree.values())
 
 
+def quarantine_gate(trained: Dict[str, jnp.ndarray],
+                    ref: Dict[str, jnp.ndarray],
+                    cms: Dict[str, jnp.ndarray],
+                    max_norm: Optional[float] = None) -> jnp.ndarray:
+    """The per-client update-quarantine gate (ISSUE 15 tentpole): a bool
+    ``[slots]`` row -- True keeps the client's update, False quarantines it
+    -- computed from values each device ALREADY holds, before the single
+    global psum.
+
+    ``trained``: per-slot locally-trained param trees ``{k: [S, ...]}``
+    (global shape on the masked engine, sliced shape in a grouped level
+    core); ``ref``: the pre-round params the slots trained from
+    (broadcast, same per-leaf shape minus the slot axis); ``cms``: the
+    per-slot count masks ``{k: [S, ...]}`` -- the exact aggregation
+    weights, so the norm term measures what would actually be summed.
+
+    The gate trips on (a) ANY non-finite element in a slot's trained tree
+    (a NaN/Inf would otherwise poison the psum: ``NaN * 0-count`` is still
+    NaN, which is why the caller must also ``where``-sanitise the trained
+    values) and (b), when ``max_norm`` is set, a masked update L2 norm
+    above it.  A non-finite delta also fails the norm comparison (NaN
+    compares False), so the two conditions compose.  Zero new collectives:
+    the row folds into the count masks BEFORE the existing psum and a
+    poisoned client becomes a zero-count participant."""
+    finite = None
+    d_sq = jnp.zeros(()) if max_norm is not None else None
+    for k, v in trained.items():
+        ax = tuple(range(1, v.ndim))
+        f = jnp.all(jnp.isfinite(v), axis=ax)
+        finite = f if finite is None else jnp.logical_and(finite, f)
+        if max_norm is not None:
+            d_sq = d_sq + jnp.sum(jnp.square((v - ref[k]) * cms[k]), axis=ax)
+    ok = finite
+    if max_norm is not None:
+        ok = jnp.logical_and(ok, d_sq <= jnp.float32(max_norm) ** 2)
+    return ok
+
+
 def round_probes(levels: Sequence[float], params: Dict[str, jnp.ndarray],
                  new_params: Dict[str, jnp.ndarray],
                  summed: Dict[str, jnp.ndarray],

@@ -154,6 +154,28 @@ class TraceRecorder:
 
     # -- finish --------------------------------------------------------
 
+    def sync(self) -> str:
+        """Flush + fsync the artifacts WITHOUT closing the recorder: the
+        rollback path's durability twin of :meth:`close` (ISSUE 15
+        satellite -- the abort path closes, but a rollback continues the
+        run, and each recovery attempt must still leave the trip evidence
+        on disk: events.jsonl fsync'd with the trip instant as its last
+        line, trace.json a point-in-time snapshot).  Returns the trace
+        path; no-op after close."""
+        if self.closed:
+            return self.trace_path
+        self._jsonl.flush()
+        os.fsync(self._jsonl.fileno())
+        with open(self.trace_path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms",
+                       "metadata": {"clock": "perf_counter",
+                                    "t0_wall": self._t0_wall}}, f)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return self.trace_path
+
     def close(self) -> str:
         """Write ``trace.json`` and close the JSONL stream; returns the
         trace path.  Idempotent (a driver finally-block and an explicit

@@ -41,6 +41,27 @@ class WatchdogError(RuntimeError):
     ``action='abort'`` -- after the trip was logged/emitted."""
 
 
+class WatchdogRollback(WatchdogError):
+    """Raised at a fetch boundary when the watchdog trips under
+    ``action='rollback'`` (ISSUE 15) -- after the trip was logged/emitted.
+    The driver catches it, restores the newest verifying checkpoint
+    generation, salts the round key stream (the replayed superstep draws a
+    fresh cohort) and retries; unhandled (e.g. outside the driver loop) it
+    degrades to the abort behaviour, which is why it subclasses
+    :class:`WatchdogError`.  ``events`` carries the trip records."""
+
+    def __init__(self, msg: str, events: List[Dict[str, Any]]):
+        super().__init__(msg)
+        self.events = events
+
+
+#: the retry-salt stream tag (ISSUE 15): rollback attempt n folds
+#: ``RETRY_SALT + n`` into the driver's host key, so every replayed
+#: superstep draws a FRESH cohort deterministically.  Shared with the
+#: chaos drill, which predicts post-rollback draws to pick poison targets.
+RETRY_SALT = 0x5EED
+
+
 class Watchdog:
     """Stateful per-run watchdog; feed it every fetched round in order."""
 
@@ -91,4 +112,19 @@ class Watchdog:
                 f"watchdog abort at round {epoch}: {events[0]['kind']} "
                 f"({events[0]}); set cfg['watchdog']['action']='warn' to "
                 f"continue through trips")
+        if events and self.spec.action == "rollback":
+            raise WatchdogRollback(
+                f"watchdog rollback at round {epoch}: {events[0]['kind']} "
+                f"({events[0]}); restoring the last good checkpoint "
+                f"generation (up to max_retries={self.spec.max_retries} "
+                f"attempts)", events)
         return events
+
+    def reset_window(self) -> None:
+        """Clear the loss-spike rolling window (ISSUE 15): after a
+        rollback the restored trajectory replays rounds whose losses will
+        re-enter the window -- keeping the poisoned run's tail would both
+        double-count and skew the median the replay is judged against.
+        ``fired`` is untouched: it is the run's full trip HISTORY (bench
+        refusals read it)."""
+        self._losses.clear()

@@ -71,7 +71,8 @@ from ..models import make_model
 from ..multi import resolve_arms_cfg
 from ..models.layout import ParamPinner
 from ..models.spec import count_masks as make_count_masks
-from ..obs import resolve_telemetry_cfg, split_probes
+from ..chaos import resolve_poison_cfg
+from ..obs import resolve_quarantine_cfg, resolve_telemetry_cfg, split_probes
 from ..obs.hist import round_hists
 from ..obs.probes import round_probes
 from ..ops.fused_update import FlatSpec
@@ -191,6 +192,14 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         # parse (the probe level table, a trace-time constant)
         self._obs_levels = sorted({float(r) for r in cfg["model_rate"]},
                                   reverse=True)
+        # client-update quarantine (ISSUE 15): the gate folds into each
+        # level core's counted sums BEFORE the level embed and the single
+        # global psum -- same zero-count-participant semantics as the
+        # masked engine, identical programs when 'off'
+        self._quarantine = resolve_quarantine_cfg(cfg)
+        # chaos NaN poison (ISSUE 15): trace-time (round, uid) table; the
+        # fused superstep threads the scan epoch into every level core
+        self._poison = resolve_poison_cfg(cfg)
         # experiment arms multiplexer (ISSUE 14, heterofl_tpu/multi/): the
         # grouped engine batches arms over its SPAN fused superstep --
         # shared host user/rate schedules (level membership is slot
@@ -225,6 +234,12 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                     "the span probe rows do not carry the arms axis yet "
                     "(a ROADMAP follow-on); the masked engine supports "
                     "telemetry x arms")
+            if self._quarantine.enabled:
+                raise ValueError(
+                    "arms with the grouped strategy need quarantine='off': "
+                    "the quarantine counter rides the probe rows, which do "
+                    "not carry the arms axis yet (a ROADMAP follow-on); "
+                    "the masked engine supports quarantine x arms")
             if self.level_placement == "slices":
                 raise ValueError(
                     "arms need level_placement='span': the slices layout "
@@ -356,7 +371,8 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
     # -- per-level program ---------------------------------------------
 
     def _level_core(self, rate: float, params, key, lr, uarr, data,
-                    n_data: int = 1, data_axis=None, local_data: bool = False):
+                    n_data: int = 1, data_axis=None, local_data: bool = False,
+                    epoch=None):
         """One level's per-device in-jit core (inside ``shard_map``): dense
         local training of this device's ``uarr`` slots at ``rate`` and the
         level's counted sums in SLICED shape.  NO collectives -- the callers
@@ -426,6 +442,19 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                         sub, 1.0, x_, y_, m_, l_, k_, lr, scaler_rate=wr,
                         data_axis=data_axis, n_data=n_data)
                 )(xs, ys, sms, lm, slot_keys)
+        if self._poison is not None:
+            # chaos NaN poison (ISSUE 15): same (round, uid) table and
+            # injection point as the masked engine -- the update goes
+            # non-finite after local training, before aggregation
+            if epoch is None:
+                raise ValueError(
+                    "chaos_poison with the grouped strategy needs the "
+                    "fused superstep (superstep_rounds > 1 or client_store"
+                    "='stream'): the K=1 host-orchestrated path does not "
+                    "thread the round epoch into its level programs")
+            from ..chaos.inject import poison_updates
+
+            trained = poison_updates(trained, self._poison, epoch, uarr)
         # counted sums in SLICED shape (within the slice the width mask is
         # all-ones by construction; only the label-split restriction remains)
         sub_shapes = {k: v.shape for k, v in sub.items()}
@@ -433,10 +462,35 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
             lambda m: m * v_,
             make_count_masks(sub_shapes, model_l.specs, model_l.groups, 1.0, l_)))(
             lm, valid)
+        ok = None
+        if self._quarantine.enabled:
+            # client-update quarantine (ISSUE 15): gate this level's slots
+            # on finiteness (+ optional masked update norm vs the sliced
+            # sub-model) and fold into sums AND counts before the embed /
+            # single global psum -- zero-count participants, exactly the
+            # masked engine's semantics at sliced shape
+            from ..obs.probes import quarantine_gate
+
+            ok = quarantine_gate(trained, sub, cms,
+                                 self._quarantine.max_norm)
+            okf = ok.astype(jnp.float32)
+            cms = {k: cms[k] * okf.reshape((-1,) + (1,) * (cms[k].ndim - 1))
+                   for k in cms}
+            trained = {k: jnp.where(ok.reshape((-1,) + (1,) * (v.ndim - 1)),
+                                    v, jnp.zeros((), v.dtype))
+                       for k, v in trained.items()}
         sum_l = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in sub}
         cnt_l = {k: jnp.sum(cms[k], axis=0) for k in sub}
-        ms = {k: v * valid for k, v in ms.items()}
-        ms["rate"] = jnp.full(uarr.shape, rate, jnp.float32) * valid
+        if ok is not None:
+            okf = ok.astype(jnp.float32)
+            ms = {k: jnp.where(ok, v, jnp.zeros((), v.dtype)) * valid
+                  for k, v in ms.items()}
+            ms["rate"] = jnp.full(uarr.shape, rate, jnp.float32) * valid * okf
+            ms["obs_quarantine"] = jnp.reshape(
+                jnp.sum(valid * (1.0 - okf)), (1,))
+        else:
+            ms = {k: v * valid for k, v in ms.items()}
+            ms["rate"] = jnp.full(uarr.shape, rate, jnp.float32) * valid
         return sum_l, cnt_l, ms
 
     def _level_prog(self, rate: float, slots: int, sub_mesh=None,
@@ -630,6 +684,12 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
             for pos, ms in zip(positions, host_levels):
                 for k in metrics:
                     metrics[k][pos] = ms[k][: len(pos)]
+            if host_levels and "obs_quarantine" in host_levels[0]:
+                # quarantine counter (ISSUE 15): per-device partials of
+                # every level concatenate; the driver's split_probes sums
+                # them into the round's quarantined-client count
+                metrics["obs_quarantine"] = np.concatenate(
+                    [ms["obs_quarantine"] for ms in host_levels])
             return metrics
 
         pending = PendingMetrics(ms_levels, assemble=_assemble)
@@ -829,7 +889,7 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                         for li, rate in enumerate(level_rates):
                             s_l, c_l, ms_l = self._level_core(
                                 rate, p_e, key_e, lr_e, srow[li], data,
-                                n_data, data_axis)
+                                n_data, data_axis, epoch=t)
                             s_l, c_l = embed(s_l, rate), embed(c_l, rate)
                             tot_se = s_l if tot_se is None else \
                                 {n: tot_se[n] + s_l[n] for n in tot_se}
@@ -884,7 +944,7 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                             s_l, c_l, ms_l = self._level_core(
                                 rate_own, p_, key_l, lr_l, u_,
                                 tuple(d) if streaming else data, 1, None,
-                                local_data=streaming)
+                                local_data=streaming, epoch=t)
                             spec_o = lay["specs"][rate_own]
                             sf, cf = spec_o.flatten(s_l), spec_o.flatten(c_l)
                             payload = {f"L{lz}": zero_tree(rz)
@@ -955,7 +1015,7 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                         d_li = tuple(x[li] for x in d) if streaming else data
                         s_l, c_l, ms_l = self._level_core(
                             rate, p, key, lr, srow[li], d_li, n_data,
-                            data_axis, local_data=streaming)
+                            data_axis, local_data=streaming, epoch=t)
                         ms_levels.append(ms_l)
                         spec_l = lay["specs"][rate]
                         sf, cf = spec_l.flatten(s_l), spec_l.flatten(c_l)
@@ -1009,7 +1069,7 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                         d_li = tuple(x[li] for x in d) if streaming else data
                         s_l, c_l, ms_l = self._level_core(
                             rate, p, key, lr, srow[li], d_li, n_data,
-                            data_axis, local_data=streaming)
+                            data_axis, local_data=streaming, epoch=t)
                         s_l, c_l = embed(s_l, rate), embed(c_l, rate)
                         tot_s = s_l if tot_s is None else \
                             {n: tot_s[n] + s_l[n] for n in tot_s}
@@ -1028,7 +1088,7 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                             s, c, m = self._level_core(
                                 rate, p_, key_l, lr_l, u_,
                                 tuple(d) if streaming else data, 1, None,
-                                local_data=streaming)
+                                local_data=streaming, epoch=t)
                             return embed(s, rate), embed(c, rate), m
                         return f
 
@@ -1387,8 +1447,10 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
 
         def _split(host):
             """Probe leaves out of a fetched metrics tree (ISSUE 10):
-            telemetry-off trees pass through untouched (None probes)."""
-            if self._obs_on:
+            telemetry-off trees pass through untouched (None probes).  The
+            quarantine counter (ISSUE 15) rides as an obs_ probe even
+            under telemetry='off'."""
+            if self._obs_on or self._quarantine.enabled:
                 return split_probes(host, self.mesh.shape["clients"],
                                     layout="span" if mode == "span"
                                     else "flat")

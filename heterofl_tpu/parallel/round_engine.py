@@ -35,10 +35,11 @@ except ImportError:  # pragma: no cover
     def _shard_map(f, mesh, in_specs, out_specs):
         return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
+from ..chaos import resolve_poison_cfg
 from ..compress import make_codec, resid_slots, resolve_codec_cfg
 from ..config import resolve_prefetch_depth
 from ..multi import resolve_arms_cfg
-from ..obs import resolve_telemetry_cfg, split_probes
+from ..obs import resolve_quarantine_cfg, resolve_telemetry_cfg, split_probes
 from ..obs.hist import round_hists
 from ..obs.probes import round_probes
 from ..data.datasets import DATASET_STATS
@@ -316,6 +317,16 @@ class _WireCodecCarry:
                 lambda: jnp.zeros(shape, jnp.float32), out_shardings=sh)()
         return self._resid
 
+    def reset_carries(self) -> None:
+        """Drop the device scan carries (EF residual / staleness buffer):
+        the rollback path (ISSUE 15) re-seeds them from the restored
+        checkpoint blob -- a NaN that reached a carry must not survive the
+        recovery.  The next dispatch rebuilds zeros via the lazy
+        ``_ensure_*`` paths unless a checkpointed carry is restored
+        first."""
+        self._resid = None
+        self._sched_buf = None
+
     def wire_resid_host(self):
         """Host copy of the error-feedback residual carry (checkpointing);
         None for the dense codec or before the first compressed round."""
@@ -437,6 +448,16 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         # parse (the probe level table, a trace-time constant)
         self._obs_levels = sorted({float(r) for r in cfg["model_rate"]},
                                   reverse=True)
+        # client-update quarantine (ISSUE 15): a per-slot finiteness (+
+        # optional update-norm) gate folded into the sums AND counts
+        # before the single psum -- a poisoned client becomes a zero-count
+        # participant.  'off' (default) builds bit-identical programs;
+        # 'on' is bit-identical whenever every update is clean (the gate
+        # multiplies by 1.0 / selects the unchanged value).
+        self._quarantine = resolve_quarantine_cfg(cfg)
+        # chaos NaN poison (ISSUE 15, heterofl_tpu/chaos/): a trace-time
+        # (round, uid) table; None (default) leaves programs untouched
+        self._poison = resolve_poison_cfg(cfg)
         if self._sched_spec.buffered and self._codec_name != "dense":
             raise ValueError(
                 "schedule aggregation='buffered' cannot combine with a "
@@ -787,7 +808,7 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
     # ------------------------------------------------------------------
 
     def _round_core(self, params, key, lr, user_loc, user_glob, data,
-                    resid=None, sched_buf=None):
+                    resid=None, sched_buf=None, epoch=None):
         """One round's in-jit core, per device (runs inside ``shard_map``):
         slot training + counted-average ``psum``.  Shared by the one-round
         program (:meth:`_build_train`) and the K-round superstep scan
@@ -885,10 +906,41 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                         data_axis="data" if n_data > 1 else None, n_data=n_data)
                 )(wr, xs, ys, sms, lm, slot_keys)
 
+        if self._poison is not None:
+            # chaos NaN poison (ISSUE 15): the matched (round, uid) slots'
+            # updates go non-finite BEFORE aggregation -- the adversarial-
+            # client model the quarantine gate / watchdog rollback recover
+            # from.  Padding slots (uid -1) never match.
+            if epoch is None:
+                raise ValueError(
+                    "chaos_poison needs the round epoch threaded into the "
+                    "round core (pass epoch= to train_round)")
+            from ..chaos.inject import poison_updates
+
+            trained = poison_updates(trained, self._poison, epoch, user_glob)
         shapes = {k: v.shape for k, v in params.items()}
         cms = jax.vmap(lambda w_, l_, v_: jax.tree_util.tree_map(
             lambda m: m * v_, make_count_masks(shapes, model.specs, model.groups, w_, l_)))(
             wr, lm, valid)
+        ok = None
+        if self._quarantine.enabled:
+            # client-update quarantine (ISSUE 15 tentpole): the gate folds
+            # into BOTH the sums and the counts BEFORE the single global
+            # psum below -- a quarantined client is a zero-count
+            # participant, and the where-select sanitises its (possibly
+            # NaN) trained values so NaN * 0-count cannot poison the sum.
+            # All-clean rounds are bit-identical: the gate multiplies by
+            # 1.0 and the select returns the unchanged value.
+            from ..obs.probes import quarantine_gate
+
+            ok = quarantine_gate(trained, params, cms,
+                                 self._quarantine.max_norm)
+            okf = ok.astype(jnp.float32)
+            cms = {k: cms[k] * okf.reshape((-1,) + (1,) * (cms[k].ndim - 1))
+                   for k in cms}
+            trained = {k: jnp.where(ok.reshape((-1,) + (1,) * (v.ndim - 1)),
+                                    v, jnp.zeros((), v.dtype))
+                       for k, v in trained.items()}
         summed = {k: jnp.sum(trained[k] * cms[k], axis=0) for k in params}
         counts = {k: jnp.sum(cms[k], axis=0) for k in params}
         codec = self._codec(params)
@@ -921,8 +973,21 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         else:
             new_params = combine_counted(params, summed, counts)
             new_buf = None
-        ms = {k: v * valid for k, v in ms.items()}
-        ms["rate"] = rates_abs * valid
+        if ok is not None:
+            # a quarantined client's metric sums may themselves be NaN
+            # (its training diverged): select-sanitise, then mask like a
+            # failed client -- its rate zeroes too, so the participation
+            # probe and the ledger see a zero-count participant.  The
+            # clean path (ok all-True) selects the unchanged values.
+            okf = ok.astype(jnp.float32)
+            ms = {k: jnp.where(ok, v, jnp.zeros((), v.dtype)) * valid
+                  for k, v in ms.items()}
+            ms["rate"] = rates_abs * valid * okf
+            ms["obs_quarantine"] = jnp.reshape(
+                jnp.sum(valid * (1.0 - okf)), (1,))
+        else:
+            ms = {k: v * valid for k, v in ms.items()}
+            ms["rate"] = rates_abs * valid
         if self._obs_on:
             # in-program health probes (ISSUE 10): derived from the
             # already-reduced aggregates and the replicated carries --
@@ -962,19 +1027,40 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             data_specs = data_specs + (P(),)
         return data_specs
 
+    def _ep_kw(self, ep) -> dict:
+        """The round core's ``epoch=`` kwarg, present ONLY when a chaos
+        poison table is configured: unpoisoned calls keep the exact
+        pre-ISSUE-15 signature (the staticcheck/wirecheck seeded-detector
+        tests monkeypatch ``_round_core`` with epoch-free stubs)."""
+        return {} if self._poison is None else {"epoch": ep}
+
     def _build_train(self):
+        # chaos poison (ISSUE 15): the K=1 program takes the round epoch
+        # as one extra replicated scalar ONLY when a poison table is
+        # configured -- unpoisoned programs keep their exact argument list
+        poisoned = self._poison is not None
+        ep_args = (P(),) if poisoned else ()
+
+        def _split_ep(extra):
+            return (extra[0] if poisoned else None), \
+                (extra[1:] if poisoned else extra)
+
         if self._codec_name != "dense":
             # compressed round (ISSUE 8): the EF residual is an extra
             # donated carry -- [1, slots, total] per device in, same out
-            def body(params, resid, key, lr, user_loc, user_glob, *data):
+            def body(params, resid, key, lr, *rest):
+                ep, rest = _split_ep(rest)
+                user_loc, user_glob, *data = rest
                 p, ms, r, _ = self._round_core(params, key, lr, user_loc,
-                                               user_glob, data, resid=resid[0])
+                                               user_glob, data,
+                                               resid=resid[0],
+                                               **self._ep_kw(ep))
                 return p, r[None], ms
 
             fn = _shard_map(
                 body, self.mesh,
-                in_specs=(P(), P("clients"), P(), P(), P("clients"),
-                          P("clients")) + self._data_specs(),
+                in_specs=(P(), P("clients"), P(), P()) + ep_args
+                + (P("clients"), P("clients")) + self._data_specs(),
                 out_specs=(P(), P("clients"), P("clients")),
             )
             # resid-only donation: donating the params carry alongside the
@@ -985,16 +1071,19 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         if self._sched_spec.buffered:
             # buffered-async round (ISSUE 9): the staleness buffer is an
             # extra donated carry -- replicated [2, total] in, same out
-            def body(params, buf, key, lr, user_loc, user_glob, *data):
+            def body(params, buf, key, lr, *rest):
+                ep, rest = _split_ep(rest)
+                user_loc, user_glob, *data = rest
                 p, ms, _, nb = self._round_core(params, key, lr, user_loc,
                                                 user_glob, data,
-                                                sched_buf=buf)
+                                                sched_buf=buf,
+                                                **self._ep_kw(ep))
                 return p, nb, ms
 
             fn = _shard_map(
                 body, self.mesh,
-                in_specs=(P(), P(), P(), P(), P("clients"),
-                          P("clients")) + self._data_specs(),
+                in_specs=(P(), P(), P(), P()) + ep_args
+                + (P("clients"), P("clients")) + self._data_specs(),
                 out_specs=(P(), P(), P("clients")),
             )
             # buf-only donation: donating the params carry alongside a
@@ -1003,14 +1092,18 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             # _SchedBufCarry) -- same policy as the codec programs
             return jax.jit(fn, donate_argnums=(1,))
 
-        def body(params, key, lr, user_loc, user_glob, *data):
+        def body(params, key, lr, *rest):
+            ep, rest = _split_ep(rest)
+            user_loc, user_glob, *data = rest
             p, ms, _, _ = self._round_core(params, key, lr, user_loc,
-                                           user_glob, data)
+                                           user_glob, data,
+                                           **self._ep_kw(ep))
             return p, ms
 
         fn = _shard_map(
             body, self.mesh,
-            in_specs=(P(), P(), P(), P("clients"), P("clients")) + self._data_specs(),
+            in_specs=(P(), P(), P()) + ep_args
+            + (P("clients"), P("clients")) + self._data_specs(),
             out_specs=(P(), P("clients")),
         )
         return jax.jit(fn, donate_argnums=(0,))
@@ -1184,7 +1277,8 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                         else:
                             ul_e, ug_e = ul_s, ug_s
                         new_p, ms, nr, _ = self._round_core(
-                            p_e, key, lr, ul_e, ug_e, data, resid=rs_e)
+                            p_e, key, lr, ul_e, ug_e, data, resid=rs_e,
+                            **self._ep_kw(t))
                         return new_p, ms, nr
 
                     if codec:
@@ -1203,7 +1297,7 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                     # slot-local cohort rows: user_loc=None = identity gather
                     new_p, ms, nr, nb = self._round_core(
                         p, key, lr, None, ug, tuple(d) + tuple(fix),
-                        resid=rs, sched_buf=sb)
+                        resid=rs, sched_buf=sb, **self._ep_kw(t))
                     return pack(new_p, nr, nb), ms
                 if in_jit:
                     (t,) = xs
@@ -1230,8 +1324,10 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
                     t, ul, ug = xs
                     key = jax.random.fold_in(base_key, t)
                 lr = lr_const if lr_arg else lr_fn(t)
-                new_p, ms, nr, nb = self._round_core(p, key, lr, ul, ug, data,
-                                                     resid=rs, sched_buf=sb)
+                new_p, ms, nr, nb = self._round_core(p, key, lr, ul, ug,
+                                                     data, resid=rs,
+                                                     sched_buf=sb,
+                                                     **self._ep_kw(t))
                 return pack(new_p, nr, nb), ms
 
             epochs = epoch0 + jnp.arange(k, dtype=jnp.int32)
@@ -1593,7 +1689,9 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             self._sched_buf = out[1]
             out = (out[0],) + out[2:]
         n_dev = self.mesh.shape["clients"]
-        obs_on = self._obs_on
+        # the quarantine counter rides the metrics pytree as an obs_ probe
+        # even under telemetry='off' (ISSUE 15): split whenever either is on
+        obs_on = self._obs_on or self._quarantine.enabled
 
         def _split(host):
             """Probe leaves out of a fetched metrics tree (ISSUE 10):
@@ -1660,7 +1758,7 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         return sum(p._cache_size() for p in progs)
 
     def train_round(self, params, key, lr, user_idx, data: Tuple[jnp.ndarray, ...],
-                    timer: PhaseTimer = None):
+                    timer: PhaseTimer = None, epoch: Optional[int] = None):
         """Run one communication round.
 
         ``user_idx``: int32 [A] active user ids.  ``data``: for vision
@@ -1725,13 +1823,23 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             # by the same policy
             params = self._staging.commit(self._pin(params))
             carry_args = self._carry_args(params)
+            ep_args = ()
+            if self._poison is not None:
+                # chaos poison (ISSUE 15): the (round, uid) match needs the
+                # round's epoch; the superstep paths thread it from the
+                # scan, the K=1 program takes it as a staged scalar
+                if epoch is None:
+                    raise ValueError(
+                        "chaos_poison needs epoch= on train_round (the "
+                        "K=1 program matches poisons by (round, uid))")
+                ep_args = (self._staging.scalar(epoch, dtype=np.int32),)
         with timer.phase("dispatch"):
             if self._codec_name != "dense":
                 new_p, self._resid, ms = self._train(
-                    params, *carry_args, key, lr, ul, ug, *args)
+                    params, *carry_args, key, lr, *ep_args, ul, ug, *args)
                 return new_p, ms
             if self._sched_spec.buffered:
                 new_p, self._sched_buf, ms = self._train(
-                    params, *carry_args, key, lr, ul, ug, *args)
+                    params, *carry_args, key, lr, *ep_args, ul, ug, *args)
                 return new_p, ms
-            return self._train(params, key, lr, ul, ug, *args)
+            return self._train(params, key, lr, *ep_args, ul, ug, *args)

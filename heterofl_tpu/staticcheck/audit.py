@@ -121,6 +121,10 @@ STEP_BODY_FUSION_BUDGET = {
     # ISSUE 12: the cohort histograms are round-level bucketing over the
     # already-emitted per-slot metric sums -- same unchanged step body
     "masked/replicated/k1-hist": 60,
+    # ISSUE 15: the quarantine gate lives at ROUND level (after local
+    # training, folded into the counted sums before the psum), never
+    # inside the local-step scan body -- same unchanged step body
+    "masked/replicated/k1-quarantine": 60,
 }
 
 
@@ -999,6 +1003,80 @@ def _obs_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
     return targets
 
 
+def _quarantine_targets(setup) -> List[Tuple[str, Any, Tuple,
+                                             Dict[str, Any]]]:
+    """Client-update quarantine variants (ISSUE 15 tentpole): the
+    finiteness (+ norm) gate folds into the counted sums and counts BEFORE
+    the single global psum, from values each device already holds -- so
+    these targets pin quarantine='on' to the EXACT budgets of the dense
+    twins: SAME one psum, SAME dense wire bytes by equality (the gate is
+    elementwise math + the one [1]-shaped obs_quarantine metrics leaf,
+    never a collective), full params donation, and the k1 program held to
+    the unchanged step-body kernel budget (the gate lives at round level,
+    outside the local-step scan).  The max_norm variant proves the
+    masked-update-norm term also stays collective-free; telemetry stays
+    OFF here, pinning the counter's ride-along contract on its own."""
+    import jax
+
+    from ..parallel import GroupedRoundEngine, RoundEngine
+    from ..parallel.grouped import _bucket_pow2
+    from ..utils.optim import make_traced_lr_fn
+
+    cfg, model, mesh = setup["cfg"], setup["model"], setup["mesh"]
+    params, key, lr = setup["params"], setup["key"], setup["lr"]
+    users = setup["users"]
+    n_dev = mesh.shape["clients"]
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    bt = setup["byte_table"]
+    top = max(bt)
+    wire = bt[top]["wire_bytes"]
+    k = 8
+    a = int(math.ceil(cfg["frac"] * users))
+    per_dev = _ceil_div(a, n_dev)
+    per_dev_g = _bucket_pow2(_ceil_div(2, n_dev))
+
+    def mem(cpd: int) -> Dict[str, int]:
+        return _mem_expect(bt, top, cpd)
+
+    qcfg = dict(cfg, quarantine="on")
+    eng = RoundEngine(model, qcfg, mesh)
+    eng._lr_fn = make_traced_lr_fn(cfg)
+    fix = (eng.fix_rates,) if eng.fix_rates is not None else ()
+    data = tuple(setup["data"]) + fix
+    slots = users + ((-users) % n_dev)
+    targets = [(
+        "masked/replicated/k1-quarantine", eng._build_train(),
+        (params, key, lr, _sds((slots,)), _sds((slots,))) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(_ceil_div(slots, n_dev))}), (
+        "masked/replicated/k8-quarantine",
+        eng._build_superstep(k, per_dev, True, num_active=a),
+        (params, key, np.int32(1)) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev)})]
+
+    neng = RoundEngine(model, dict(cfg, quarantine={"max_norm": 100.0}),
+                       mesh)
+    neng._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "masked/replicated/k8-quarantine-norm",
+        neng._build_superstep(k, per_dev, True, num_active=a),
+        (params, key, np.int32(1)) + data,
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev)}))
+
+    grp = GroupedRoundEngine(qcfg, mesh)
+    grp._lr_fn = make_traced_lr_fn(cfg)
+    targets.append((
+        "grouped/span/k8-fused-quarantine",
+        grp._superstep_prog(k, per_dev_g, "span"),
+        (params, key, np.int32(1),
+         _sds((k, len(grp.levels), per_dev_g * n_dev))) + data[:4],
+        {"donated": n_leaves, "psum": PSUM_BUDGET, "wire_bytes": wire,
+         "mem": mem(per_dev_g)}))
+    return targets
+
+
 def _obs_hist_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
     """Cohort-histogram telemetry variants (ISSUE 12): ``telemetry='hist'``
     folds the fixed-bucket cohort histograms (obs/hist.py: per-client
@@ -1569,6 +1647,7 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
     targets.extend(_sched_targets(setup))
     targets.extend(_obs_targets(setup))
     targets.extend(_obs_hist_targets(setup))
+    targets.extend(_quarantine_targets(setup))
     targets.extend(_arms_targets(setup))
     for name, prog, args, expect in targets:
         report.add_program(audit_program(name, prog, args, expect, mesh))

@@ -1,8 +1,10 @@
 from .compile_cache import default_cache_dir, enable_persistent_cache  # noqa: F401
 from .checkpoint import (  # noqa: F401
+    CheckpointCorruptError,
     checkpoint_path,
     copy_best,
     load_checkpoint,
+    load_newest_verifying,
     resume,
     save_checkpoint,
 )

@@ -17,6 +17,7 @@ from __future__ import annotations
 import hashlib
 import os
 import sys
+from contextlib import contextmanager
 from typing import Optional
 
 
@@ -83,3 +84,42 @@ def enable_persistent_cache(path: Optional[str] = None) -> str:
 
         jax.config.update("jax_compilation_cache_dir", path)
     return path
+
+
+@contextmanager
+def no_persistent_cache():
+    """Compile fresh (no persistent-cache reads OR writes) for the scope.
+
+    The chaos drill (ISSUE 15) runs kill -> resume cycles inside ONE
+    process; resuming with programs *deserialized* from a warm persistent
+    cache while the killed run's donated buffers are still being reclaimed
+    trips the XLA:CPU serialized-executable donation bug this repo already
+    priced in for codec programs (MEASUREMENTS.md Round 10): bitwise-
+    nondeterministic params on a stable subset of leaves, fresh compiles
+    always correct (reproduced 3/4 warm vs 5/5 clean cold on the drill's
+    corruption-fallback plan).  The drill therefore compiles its small
+    synthetic programs fresh; everything outside the scope keeps the warm
+    cache, so the tier-1 gate's cache contract is untouched.
+
+    The config flag alone is NOT enough: ``compilation_cache.
+    is_cache_used`` latches its decision in module globals at the first
+    compile, so in a process that already compiled with the cache on
+    (pytest under conftest's warm cache) a later flag flip is silently
+    ignored -- ``reset_cache()`` drops the latch (and the initialized
+    cache object) so the flag is re-read inside and after the scope."""
+    import jax
+
+    try:
+        from jax._src import compilation_cache as _cc
+    except ImportError:  # pragma: no cover - jax internals moved
+        _cc = None
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    if _cc is not None:
+        _cc.reset_cache()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev)
+        if _cc is not None:
+            _cc.reset_cache()

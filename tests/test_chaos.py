@@ -1,0 +1,565 @@
+"""Fault-tolerant runtime (ISSUE 15): chaos harness, durable generational
+checkpoints, watchdog auto-rollback and in-program client quarantine.
+
+Contracts under test:
+
+* ``quarantine='off'`` (the default) and all-clean updates under
+  ``quarantine='on'`` are BIT-IDENTICAL to the pre-quarantine engines
+  across masked x {replicated, sharded} / grouped span x K in {1, 8} --
+  the gate is a pure observer until an update is actually poisoned.
+* a NaN-poisoned client update (``cfg['chaos_poison']``) is quarantined
+  in-program: finite final params, a zero-count participant, and the
+  ``quarantined`` counter riding the probe record; un-gated the same
+  poison reaches the globals (the watchdog-rollback drill's trigger).
+* every checkpoint write is durable + checksummed: corruption (bit-flip
+  or truncation) raises the typed :class:`CheckpointCorruptError`,
+  ``resume`` falls back generation-by-generation to the newest verifying
+  blob, and rotation keeps exactly ``checkpoint_keep`` generations.
+* the chaos drill's recovery contract holds: for every named kill point
+  the resumed run's final params are bitwise equal to the uninterrupted
+  run's (fast subset here; the full kill matrix is slow-marked), and a
+  NaN-poisoned run under ``action='rollback'`` completes without human
+  intervention, leaving the trip instant as the last on-disk event
+  before each rollback's recovery record.
+"""
+
+import json
+import os
+import pickle
+
+import jax
+import numpy as np
+import pytest
+
+from heterofl_tpu import config as C
+from heterofl_tpu.chaos import (ChaosKill, FaultInjector, corrupt_blob,
+                                resolve_fault_plan, resolve_poison_cfg)
+from heterofl_tpu.fed.core import (superstep_rate_schedule,
+                                   superstep_user_schedule)
+from heterofl_tpu.models import make_model
+from heterofl_tpu.obs import resolve_quarantine_cfg, split_probes
+from heterofl_tpu.parallel import GroupedRoundEngine, RoundEngine, make_mesh
+from heterofl_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                           checkpoint_path, copy_best,
+                                           generation_path, generation_paths,
+                                           load_checkpoint,
+                                           load_newest_verifying, resume,
+                                           save_checkpoint)
+
+from test_obs import _metrics_equal, _params_equal
+from test_round import _vision_setup
+
+HOST_KEY = jax.random.key(0)
+
+
+# ---------------------------------------------------------------------------
+# config validation: quarantine / fault plans / poison tables
+# ---------------------------------------------------------------------------
+
+def test_quarantine_config_validation():
+    assert not resolve_quarantine_cfg({"quarantine": "off"}).enabled
+    assert not resolve_quarantine_cfg({}).enabled
+    on = resolve_quarantine_cfg({"quarantine": "on"})
+    assert on.enabled and on.max_norm is None
+    nm = resolve_quarantine_cfg({"quarantine": {"max_norm": 2.5}})
+    assert nm.enabled and nm.max_norm == 2.5
+    for bad in ("loud", {"max_norm": -1.0}, {"max_norm": True},
+                {"bogus": 1}, 7):
+        with pytest.raises(ValueError):
+            resolve_quarantine_cfg({"quarantine": bad})
+
+
+def test_poison_table_validation():
+    assert resolve_poison_cfg({}) is None
+    t = resolve_poison_cfg({"chaos_poison": [[3, 1], [4, 0]]})
+    assert t.dtype == np.int32 and t.shape == (2, 2)
+    for bad in ([], [[1]], [[1, 2, 3]], [[-1, 0]], [[1, -2]], [[1.5, 0]],
+                [[True, 0]], "3,1"):
+        with pytest.raises(ValueError):
+            resolve_poison_cfg({"chaos_poison": bad})
+
+
+def test_fault_plan_validation():
+    plan = resolve_fault_plan({"kills": [{"point": "fetch", "at": 2},
+                                         {"point": "fetch", "at": 4}],
+                               "corrupt": [{"which": "best",
+                                            "mode": "truncate",
+                                            "generation": 1}],
+                               "poison": [[2, 5]]})
+    assert plan.kills == {"fetch": [2, 4]} and plan.n_kills == 2
+    assert plan.corrupt[0]["mode"] == "truncate"
+    assert plan.poison.shape == (1, 2)
+    for bad in ("x", {"bogus": []}, {"kills": [{"point": "nope"}]},
+                {"kills": [{"point": "fetch", "at": 0}]},
+                {"corrupt": [{"which": "live"}]},
+                {"corrupt": [{"mode": "scramble"}]},
+                {"corrupt": [{"generation": -1}]}):
+        with pytest.raises(ValueError):
+            resolve_fault_plan(bad)
+
+
+def test_fault_injector_counts_and_kills():
+    inj = FaultInjector(resolve_fault_plan(
+        {"kills": [{"point": "superstep", "at": 2}]}))
+    inj.check("superstep")  # occurrence 1: survives
+    with pytest.raises(ChaosKill) as e:
+        inj.check("superstep")
+    assert e.value.point == "superstep" and e.value.occurrence == 2
+    assert inj.fired == [("superstep", 2)]
+    assert not issubclass(ChaosKill, Exception)  # uncatchable by recovery
+    with pytest.raises(ValueError):
+        inj.check("reboot")
+
+
+# ---------------------------------------------------------------------------
+# durable generational checkpoints
+# ---------------------------------------------------------------------------
+
+def _blob(epoch, val=0.0):
+    return {"epoch": epoch, "params": {"w": np.full(64, val, np.float32)}}
+
+
+def test_checkpoint_corruption_raises_typed(tmp_path):
+    path = checkpoint_path(str(tmp_path), "tag")
+    save_checkpoint(path, _blob(1))
+    assert load_checkpoint(path)["epoch"] == 1
+    raw = open(path, "rb").read()
+    # bit-flip deep in the payload: the checksum must catch it
+    corrupt_blob(path, "flip")
+    with pytest.raises(CheckpointCorruptError, match="SHA-256"):
+        load_checkpoint(path)
+    open(path, "wb").write(raw)
+    corrupt_blob(path, "truncate")
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path)
+    os.remove(path)
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(path)
+
+
+def test_checkpoint_legacy_headerless_blob(tmp_path):
+    # pre-ISSUE-15 blobs are raw pickles: still loadable, and an
+    # unpickling failure maps onto the typed error (satellite: the bare
+    # pickle.load no longer leaks raw tracebacks)
+    path = checkpoint_path(str(tmp_path), "tag")
+    os.makedirs(os.path.dirname(path))
+    with open(path, "wb") as f:
+        pickle.dump(_blob(7), f)
+    assert load_checkpoint(path)["epoch"] == 7
+    with open(path, "wb") as f:
+        f.write(b"not a pickle at all")
+    with pytest.raises(CheckpointCorruptError, match="unpickling"):
+        load_checkpoint(path)
+
+
+def test_checkpoint_rotation_keeps_generations(tmp_path):
+    path = checkpoint_path(str(tmp_path), "tag")
+    for e in range(1, 6):
+        save_checkpoint(path, _blob(e, float(e)), keep=3)
+    gens = generation_paths(path)
+    assert [os.path.basename(p) for p in gens] == [
+        "tag_checkpoint.pkl", "tag_checkpoint.pkl.g1",
+        "tag_checkpoint.pkl.g2"]
+    assert [load_checkpoint(p)["epoch"] for p in gens] == [5, 4, 3]
+    # keep=1 (the seed behaviour): no rotated generations ever appear
+    p1 = checkpoint_path(str(tmp_path), "solo")
+    for e in range(1, 4):
+        save_checkpoint(p1, _blob(e), keep=1)
+    assert generation_paths(p1) == [p1]
+    assert load_checkpoint(p1)["epoch"] == 3
+
+
+def test_generation_walk_tolerates_rotation_gap(tmp_path):
+    # a crash between _rotate's renames can leave {live, .g2} with no
+    # .g1: the fallback walk must still reach the older verifying blob
+    out = str(tmp_path)
+    path = checkpoint_path(out, "tag")
+    for e in (1, 2, 3):
+        save_checkpoint(path, _blob(e, float(e)), keep=3)
+    os.remove(generation_path(path, 1))  # the gap
+    assert [load_checkpoint(p)["epoch"] for p in generation_paths(path)] \
+        == [3, 1]
+    corrupt_blob(path, "flip")
+    with pytest.warns(UserWarning, match="checkpoint-corrupt"):
+        blob = resume(out, "tag", mode=1)
+    assert blob["epoch"] == 1  # crossed the gap to .g2
+
+
+def test_resume_falls_back_a_generation_loudly(tmp_path):
+    out = str(tmp_path)
+    path = checkpoint_path(out, "tag")
+    for e in (1, 2, 3):
+        save_checkpoint(path, _blob(e, float(e)), keep=3)
+    corrupt_blob(path, "flip")
+    with pytest.warns(UserWarning, match="checkpoint-corrupt"):
+        blob = resume(out, "tag", mode=1)
+    assert blob["epoch"] == 2  # newest VERIFYING generation
+    # every generation corrupt -> typed error, never a silent fresh start
+    corrupt_blob(generation_path(path, 1), "truncate")
+    corrupt_blob(generation_path(path, 2), "flip")
+    with pytest.raises(CheckpointCorruptError, match="refusing"):
+        with pytest.warns(UserWarning):
+            resume(out, "tag", mode=1)
+    # absent is still a clean fresh start, not an error
+    assert resume(out, "ghost", mode=1) is None
+    assert load_newest_verifying(checkpoint_path(out, "ghost")) is None
+
+
+def test_copy_best_is_durable_and_checksummed(tmp_path):
+    out = str(tmp_path)
+    save_checkpoint(checkpoint_path(out, "tag"), _blob(4, 1.5))
+    copy_best(out, "tag")
+    best = load_checkpoint(checkpoint_path(out, "tag", "best"))
+    assert best["epoch"] == 4
+    # the copy carries the checksum header: corruption is detected
+    corrupt_blob(checkpoint_path(out, "tag", "best"), "flip")
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(checkpoint_path(out, "tag", "best"))
+    # no stray tmp file survives the write
+    assert not any(f.endswith(".tmp") for f in os.listdir(
+        os.path.join(out, "model")))
+
+
+# ---------------------------------------------------------------------------
+# quarantine bit-identity: off == on when every update is clean
+# ---------------------------------------------------------------------------
+
+def test_masked_k1_quarantine_on_off_bit_identical():
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    uidx = np.array([0, 2, 4, 6])
+    results = {}
+    for q in ("off", "on"):
+        eng = RoundEngine(model, dict(cfg, quarantine=q), mesh)
+        p = model.init(jax.random.key(0))
+        p, ms = eng.train_round(p, jax.random.key(1), 0.05, uidx, data)
+        results[q] = (p, {k: np.asarray(v) for k, v in ms.items()})
+    p_off, ms_off = results["off"]
+    p_on, ms_on = results["on"]
+    _params_equal(p_off, p_on)
+    assert not any(k.startswith("obs_") for k in ms_off)
+    clean, probes = split_probes(ms_on, 4)
+    assert probes[0]["quarantined"] == 0
+    for name in ms_off:
+        np.testing.assert_array_equal(ms_off[name], clean[name], err_msg=name)
+
+
+@pytest.mark.parametrize("q", [
+    "on",
+    pytest.param({"max_norm": 1e6}, marks=pytest.mark.slow),
+])
+def test_masked_superstep_quarantine_on_off_bit_identical(q):
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    k = 8
+    outs = {}
+    for mode in ("off", q):
+        eng = RoundEngine(model, dict(cfg, quarantine=mode), mesh)
+        p = model.init(jax.random.key(0))
+        p, pending = eng.train_superstep(p, HOST_KEY, 1, k, data,
+                                         num_active=4)
+        outs[str(mode)] = (p, pending.fetch())
+    _params_equal(outs["off"][0], outs[str(q)][0])
+    _metrics_equal(outs["off"][1], outs[str(q)][1], k)
+    probes = outs[str(q)][1]["obs"]
+    assert len(probes) == k
+    assert all(rec["quarantined"] == 0 for rec in probes)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("placement,k", [("span", 8), ("span", 1),
+                                         ("slices", 8)])
+def test_grouped_quarantine_on_off_bit_identical(placement, k):
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(8, 1)  # slices needs >= 5 device rows (one per level)
+    model = make_model(cfg)
+    users = cfg["num_users"]
+    sched = superstep_user_schedule(HOST_KEY, 1, k, users, users)
+    rates = superstep_rate_schedule(HOST_KEY, 1, k, cfg, sched)
+    outs = {}
+    for q in ("off", "on"):
+        grp = GroupedRoundEngine(dict(cfg, level_placement=placement,
+                                      quarantine=q), mesh)
+        p = model.init(jax.random.key(0))
+        p, pending = grp.train_superstep(p, HOST_KEY, 1, k, sched, rates,
+                                         data)
+        outs[q] = (p, pending.fetch())
+    _params_equal(outs["off"][0], outs["on"][0])
+    _metrics_equal(outs["off"][1], outs["on"][1], k)
+    probes = outs["on"][1]["obs"]
+    assert all(rec["quarantined"] == 0 for rec in probes)
+
+
+# ---------------------------------------------------------------------------
+# poisoned updates: quarantined in-program, or poisoning the globals un-gated
+# ---------------------------------------------------------------------------
+
+def test_masked_k1_poison_quarantined():
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    uidx = np.array([0, 2, 4, 6])
+    poison = [[1, 2]]  # round 1, uid 2 (slot 1 of the cohort)
+    # un-gated: the poison reaches the globals through the psum
+    bad = RoundEngine(model, dict(cfg, chaos_poison=poison), mesh)
+    p = model.init(jax.random.key(0))
+    p_bad, _ = bad.train_round(p, jax.random.key(1), 0.05, uidx, data,
+                               epoch=1)
+    assert not all(bool(np.all(np.isfinite(np.asarray(v))))
+                   for v in p_bad.values())
+    # gated: finite params, zero-count participant, counted probe
+    eng = RoundEngine(model, dict(cfg, quarantine="on",
+                                  chaos_poison=poison), mesh)
+    clean = RoundEngine(model, cfg, mesh)
+    p0 = model.init(jax.random.key(0))
+    p_q, ms_q = eng.train_round(p0, jax.random.key(1), 0.05, uidx, data,
+                                epoch=1)
+    assert all(bool(np.all(np.isfinite(np.asarray(v))))
+               for v in p_q.values())
+    ms_q, probes = split_probes({k: np.asarray(v) for k, v in ms_q.items()},
+                                4)
+    assert probes[0]["quarantined"] == 1
+    assert float(ms_q["n"][1]) == 0.0 and float(ms_q["rate"][1]) == 0.0
+    # a non-poisoned round of the same engine is bit-identical to clean
+    p1, _ = eng.train_round(model.init(jax.random.key(0)),
+                            jax.random.key(1), 0.05, uidx, data, epoch=2)
+    p2, _ = clean.train_round(model.init(jax.random.key(0)),
+                              jax.random.key(1), 0.05, uidx, data)
+    _params_equal(p1, p2)
+
+
+@pytest.mark.slow
+def test_masked_superstep_poison_quarantined():
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    k = 4
+    sched = np.asarray(superstep_user_schedule(HOST_KEY, 1, k,
+                                               cfg["num_users"], 4))
+    uid = int(sched[2][0])  # poison a drawn (round 3, uid) update
+    eng = RoundEngine(model, dict(cfg, quarantine="on",
+                                  chaos_poison=[[3, uid]]), mesh)
+    p = model.init(jax.random.key(0))
+    p, pending = eng.train_superstep(p, HOST_KEY, 1, k, data, num_active=4)
+    out = pending.fetch()
+    assert all(bool(np.all(np.isfinite(np.asarray(v))))
+               for v in p.values())
+    probes = out["obs"]
+    assert [rec["quarantined"] for rec in probes] == [0, 0, 1, 0]
+
+
+@pytest.mark.slow
+def test_grouped_span_superstep_poison_quarantined():
+    cfg, ds, data = _vision_setup()
+    mesh = make_mesh(8, 1)
+    model = make_model(cfg)
+    users = cfg["num_users"]
+    k = 4
+    sched = np.asarray(superstep_user_schedule(HOST_KEY, 1, k, users, users))
+    rates = superstep_rate_schedule(HOST_KEY, 1, k, cfg, sched)
+    uid = int(sched[1][0])
+    grp = GroupedRoundEngine(dict(cfg, quarantine="on",
+                                  chaos_poison=[[2, uid]]), mesh)
+    p = model.init(jax.random.key(0))
+    p, pending = grp.train_superstep(p, HOST_KEY, 1, k, sched, rates, data)
+    out = pending.fetch()
+    assert all(bool(np.all(np.isfinite(np.asarray(v))))
+               for v in p.values())
+    assert [rec["quarantined"] for rec in out["obs"]] == [0, 1, 0, 0]
+
+
+def test_max_norm_gate_quarantines_outlier():
+    # a tiny norm bound quarantines EVERY update: counts go zero and the
+    # counted average keeps the previous globals (stale fallback)
+    cfg, ds, data = _vision_setup()
+    model = make_model(cfg)
+    mesh = make_mesh(4, 1)
+    uidx = np.array([0, 2, 4, 6])
+    eng = RoundEngine(model, dict(cfg, quarantine={"max_norm": 1e-12}),
+                      mesh)
+    p0 = model.init(jax.random.key(0))
+    p0_host = {k: np.asarray(v) for k, v in p0.items()}  # p0 is donated
+    p1, ms = eng.train_round(p0, jax.random.key(1), 0.05, uidx, data)
+    _, probes = split_probes({k: np.asarray(v) for k, v in ms.items()}, 4)
+    assert probes[0]["quarantined"] == 4
+    _params_equal(p0_host, p1)
+
+
+# ---------------------------------------------------------------------------
+# driver-level recovery: rollback completes, artifacts are durable
+# ---------------------------------------------------------------------------
+
+def _read_log(cfg, tag):
+    path = os.path.join(cfg["output_dir"], "runs", f"train_{tag}",
+                        "log.jsonl")
+    return [json.loads(line) for line in open(path)]
+
+
+def test_driver_rollback_recovers_from_poison(tmp_path):
+    from heterofl_tpu.chaos.drill import drill_cfg, pick_poison_uid
+    from heterofl_tpu.entry.common import FedExperiment
+
+    base = drill_cfg(str(tmp_path))
+    uid = pick_poison_uid(base, 0, 3)
+    assert uid is not None
+    trace_dir = str(tmp_path / "trace")
+    cfg = drill_cfg(str(tmp_path), chaos_poison=[[3, int(uid)]],
+                    telemetry="on", trace_dir=trace_dir, ledger="on",
+                    watchdog={"action": "rollback", "max_retries": 3,
+                              "backoff": 0.0})
+    exp = FedExperiment(cfg, 0)
+    with pytest.warns(UserWarning, match="rollback attempt"):
+        res = exp.run("Global-Accuracy")
+    assert all(bool(np.all(np.isfinite(np.asarray(v))))
+               for v in res["params"].values())
+    log = _read_log(cfg, exp.tag)
+    trips = [i for i, r in enumerate(log) if r.get("tag") == "obs"
+             and r.get("event") == "watchdog"]
+    recs = [i for i, r in enumerate(log) if r.get("tag") == "recovery"]
+    assert len(trips) == 1 and len(recs) == 1
+    # durability parity (satellite): the trip instant is on disk BEFORE
+    # the recovery record -- the last pre-rollback event is the trip
+    assert trips[0] < recs[0]
+    assert log[recs[0]]["attempt"] == 1
+    assert log[recs[0]]["restored_epoch"] is not None
+    # the budget re-armed on the clean post-recovery checkpoint
+    assert exp._rollback_attempts == 0
+    # the abort path's artifacts, on the ROLLBACK path too (satellite):
+    # events.jsonl carries the watchdog trip instant before the recovery
+    # instant, and ledger.npz was snapshotted
+    events = [json.loads(l) for l in
+              open(os.path.join(trace_dir, exp.tag, "events.jsonl"))]
+    names = [e.get("name") for e in events]
+    assert "watchdog" in names and "recovery" in names
+    assert names.index("watchdog") < names.index("recovery")
+    assert os.path.exists(os.path.join(trace_dir, exp.tag, "ledger.npz"))
+
+
+def test_rollback_blob_rejects_nonfinite_carries(tmp_path):
+    # a checksum-clean generation whose params are finite but whose
+    # restored CARRY holds the NaN must fall back a generation -- else
+    # the retry budget burns on one poisoned blob
+    from heterofl_tpu.chaos.drill import drill_cfg
+    from heterofl_tpu.entry.common import FedExperiment
+
+    cfg = drill_cfg(str(tmp_path))
+    exp = FedExperiment(cfg, 0)
+    path = checkpoint_path(cfg["output_dir"], exp.tag)
+    good = {"epoch": 2, "params": {"w": np.ones(4, np.float32)},
+            "sched_buf": None}
+    bad = {"epoch": 3, "params": {"w": np.ones(4, np.float32)},
+           "sched_buf": np.full((2, 4), np.nan, np.float32)}
+    save_checkpoint(path, good, keep=3)
+    save_checkpoint(path, bad, keep=3)
+    with pytest.warns(UserWarning, match="non-finite params or carries"):
+        blob = exp._load_rollback_blob()
+    assert blob["epoch"] == 2
+
+
+def test_driver_rollback_recovers_trip_from_final_drain(tmp_path):
+    # metrics_fetch_every == K defers each superstep's fetch by one push:
+    # a poison in the LAST superstep only surfaces at the post-loop
+    # drain, which must roll back and re-enter the round loop instead of
+    # degrading to an abort
+    from heterofl_tpu.chaos.drill import drill_cfg, pick_poison_uid
+    from heterofl_tpu.entry.common import FedExperiment
+
+    base = drill_cfg(str(tmp_path))
+    uid = pick_poison_uid(base, 0, 4)
+    assert uid is not None
+    cfg = drill_cfg(str(tmp_path), chaos_poison=[[4, int(uid)]],
+                    telemetry="on", metrics_fetch_every=2,
+                    eval_interval=5,  # no eval boundary flushes the defer
+                    watchdog={"action": "rollback", "max_retries": 3,
+                              "backoff": 0.0})
+    exp = FedExperiment(cfg, 0)
+    with pytest.warns(UserWarning, match="rollback attempt"):
+        res = exp.run("Global-Accuracy")
+    assert all(bool(np.all(np.isfinite(np.asarray(v))))
+               for v in res["params"].values())
+    log = _read_log(cfg, exp.tag)
+    assert sum(1 for r in log if r.get("tag") == "recovery") >= 1
+
+
+def test_driver_rollback_budget_escalates_to_abort(tmp_path):
+    from heterofl_tpu.chaos.drill import drill_cfg
+    from heterofl_tpu.entry.common import FedExperiment
+    from heterofl_tpu.obs.watchdog import WatchdogError
+
+    # poison EVERY cohort member at rounds 3 and 4: no salted redraw can
+    # dodge it, so the rollback budget burns down and escalates
+    cfg = drill_cfg(str(tmp_path),
+                    chaos_poison=[[r, u] for r in (3, 4) for u in range(8)],
+                    telemetry="on",
+                    watchdog={"action": "rollback", "max_retries": 2,
+                              "backoff": 0.0})
+    exp = FedExperiment(cfg, 0)
+    with pytest.warns(UserWarning):
+        with pytest.raises(WatchdogError, match="budget spent"):
+            exp.run("Global-Accuracy")
+    log = _read_log(cfg, exp.tag)
+    recs = [r for r in log if r.get("tag") == "recovery"]
+    assert len(recs) == 2  # both attempts, then the escalation
+
+
+# ---------------------------------------------------------------------------
+# the chaos drill: fast smoke subset (full kill matrix is slow-marked)
+# ---------------------------------------------------------------------------
+
+def test_kill_drill_checkpoint_resume_bitwise(tmp_path):
+    from heterofl_tpu.chaos.drill import run_kill_drill
+
+    plan = resolve_fault_plan({"kills": [{"point": "checkpoint", "at": 2}]})
+    rep = run_kill_drill(plan, {}, str(tmp_path))
+    assert rep["ok"] and rep["bitwise_equal"]
+    assert rep["kills_fired"] == [("checkpoint", 2)] and rep["resumes"] == 1
+
+
+@pytest.mark.slow
+def test_corrupt_drill_falls_back_a_generation(tmp_path):
+    from heterofl_tpu.chaos.drill import run_kill_drill
+
+    # kill before the 3rd checkpoint write (two generations on disk),
+    # corrupt the newest: resume must fall back to .g1 and still land
+    # bitwise on the uninterrupted trajectory
+    plan = resolve_fault_plan(
+        {"kills": [{"point": "checkpoint", "at": 3}],
+         "corrupt": [{"which": "checkpoint", "mode": "flip",
+                      "generation": 0}]})
+    with pytest.warns(UserWarning, match="checkpoint-corrupt"):
+        rep = run_kill_drill(plan, {"num_epochs": {"global": 6, "local": 1}},
+                             str(tmp_path))
+    assert rep["ok"] and rep["bitwise_equal"], rep
+    assert len(rep["corruptions"]) == 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strategy,store,point", [
+    ("masked", "eager", "superstep"),
+    ("masked", "eager", "fetch"),
+    ("masked", "eager", "checkpoint"),
+    ("masked", "stream", "prefetch"),
+    ("grouped", "eager", "superstep"),
+    ("grouped", "eager", "fetch"),
+    ("grouped", "eager", "checkpoint"),
+    ("grouped", "stream", "prefetch"),
+])
+def test_kill_matrix_resume_bitwise(tmp_path, strategy, store, point):
+    from heterofl_tpu.chaos.drill import run_kill_drill
+
+    plan = resolve_fault_plan({"kills": [{"point": point, "at": 1}]})
+    over = {"strategy": strategy, "client_store": store}
+    rep = run_kill_drill(plan, over, str(tmp_path))
+    assert rep["ok"] and rep["bitwise_equal"], rep
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strategy", ["masked", "grouped"])
+@pytest.mark.parametrize("mode", ["quarantine", "rollback"])
+def test_poison_drill_matrix(tmp_path, strategy, mode):
+    from heterofl_tpu.chaos.drill import run_poison_drill
+
+    rep = run_poison_drill(mode, {"strategy": strategy}, str(tmp_path))
+    assert rep["ok"] and rep["final_params_finite"], rep
