@@ -113,6 +113,15 @@ abort the record is refused -- extra.chaos carries the escalation
 evidence instead of an MTTR, because a recovery time measured through a
 run that needed human intervention is not a recovery time.
 
+BENCH_POD=1 (ISSUE 17): the 2-process pod probe -- a real jax.distributed
+CPU mesh (gloo collectives) runs the fused grouped-slices superstep with
+the levels host-aligned on disjoint processes, recording per-process
+rounds/sec + checkpoint-write times, the DCN classification from the real
+process grid, and the bitwise-vs-single-process gate into extra.pod.
+Refused when STATICCHECK.json reports a failed multi-host DCN budget
+audit (extra.wire also carries the analytic per-link ICI-vs-DCN split
+per strategy either way).
+
 BENCH_LEDGER=1 (ISSUE 12): the population-observatory A/B -- one measure
 with telemetry='hist' (cohort histograms riding the metrics fetch) PLUS a
 host-side ClientLedger folded O(active) per fetch from the recomputed
@@ -217,7 +226,19 @@ def _load_staticcheck():
     # produced without --diff-baseline; a checked-and-regressed ratchet
     # blocks recording the same way a failing audit does (see main)
     ratchet = rec.get("ratchet") or {}
+    # DCN budget status (ISSUE 17): the multi-host program entries' wire
+    # findings plus the AOT v4-128 record -- BENCH_POD refuses to record
+    # pod numbers against a failed DCN budget audit.  None when the
+    # artifact predates the multi-host matrix.
+    mh_findings = [f for name, p in progs.items() if name.endswith("/mh")
+                   for f in (p.get("findings") or [])]
+    aot = (rec.get("config") or {}).get("aot_v4128") or {}
+    dcn_audit_ok = None
+    if any(name.endswith("/mh") for name in progs):
+        dcn_audit_ok = (not mh_findings
+                        and aot.get("ok", True) is not False)
     return {"ok": bool(rec.get("ok")),
+            "dcn_audit_ok": dcn_audit_ok,
             "stale": newest_src > artifact_mtime,
             "generated_at": rec.get("generated_at"),
             "programs_audited": len(progs),
@@ -718,7 +739,8 @@ def main():
     # are the sliced payloads of the grouped engine's K=1 per-level psums.
     from heterofl_tpu.compress import LOSSY_CODECS
     from heterofl_tpu.fed.core import level_byte_table, level_codec_byte_table
-    from heterofl_tpu.staticcheck.wire import codec_round_wire, dense_round_wire
+    from heterofl_tpu.staticcheck.wire import (codec_round_wire,
+                                               dense_round_wire, link_split)
 
     byte_table = level_byte_table(cfg)
     top_rate = max(byte_table)
@@ -749,6 +771,21 @@ def main():
         "codecs": {c: codec_round_wire(c, b, dense_payload, n_dev_wire)
                    for c, b in sorted(codec_bytes.items())},
         "strategies": {s: strategy_wire() for s in ("masked", "grouped")},
+        # per-link ICI-vs-DCN split (ISSUE 17 satellite): the same
+        # analytic payload priced per bidirectional-ring link -- all-ICI
+        # at this run's process layout, plus the 2-process pod-probe
+        # projection where the host-aligned slices placement puts exactly
+        # h links on DCN (staticcheck.wire.link_split)
+        "link_split": {s: {
+            "this_run": link_split(
+                dense_payload if wire_codec == "dense"
+                else codec_bytes[wire_codec],
+                n_dev_wire, jax.process_count()),
+            "pod_2proc": link_split(
+                dense_payload if wire_codec == "dense"
+                else codec_bytes[wire_codec],
+                n_dev_wire, 2),
+        } for s in ("masked", "grouped")},
     }
     shard_n = store.shard_max if population else x.shape[1]
     local_steps = cfg["num_epochs"]["local"] * int(
@@ -1071,6 +1108,7 @@ def main():
     obs_ab = {}   # filled by the BENCH_TELEMETRY pass; emitted when non-empty
     arms_ab = {}  # filled by the BENCH_ARMS pass (ISSUE 14)
     chaos_ab = {}  # filled by the BENCH_CHAOS pass (ISSUE 15)
+    pod_ab = {}   # filled by the BENCH_POD pass (ISSUE 17)
 
     def emit(ctx, rounds_done, strategies=None):
         # a degraded (non-flagship-volume / wrong-platform) run must not
@@ -1146,6 +1184,7 @@ def main():
                       **({"obs": obs_ab} if obs_ab else {}),
                       **({"arms": arms_ab} if arms_ab else {}),
                       **({"chaos": chaos_ab} if chaos_ab else {}),
+                      **({"pod": pod_ab} if pod_ab else {}),
                       **({"degraded": degraded} if degraded else {})},
         }), flush=True)
 
@@ -1639,6 +1678,65 @@ def main():
         except Exception as e:
             chaos_ab.update({"error": repr(e)})
             print(f"bench: chaos drills failed: {e!r}", file=sys.stderr)
+        emit(ctx, timed_rounds, strategies=strategies or None)
+
+    # BENCH_POD=1 (ISSUE 17): the 2-process pod probe -- a REAL
+    # jax.distributed CPU mesh (gloo collectives) runs the fused
+    # grouped-slices superstep with levels on disjoint processes, recorded
+    # into extra.pod: per-process rounds/sec + checkpoint-write times, the
+    # DCN classification from the real process grid (exactly one dense
+    # reduction per training round), and the bitwise gate vs the
+    # 1-process gloo reference.  A failed multi-host DCN budget audit
+    # REFUSES the numbers: pod rounds/sec against an unaudited wire
+    # contract would launder broken placement into the trajectory.
+    if os.environ.get("BENCH_POD") == "1":
+        if staticcheck is not None \
+                and staticcheck.get("dcn_audit_ok") is False:
+            pod_ab.update({
+                "error": "STATICCHECK.json reports a failed multi-host DCN "
+                         "budget audit; refusing to record pod numbers. "
+                         "Rerun `python -m heterofl_tpu.staticcheck "
+                         "--aot-v4128`."})
+        else:
+            try:
+                import tempfile
+
+                from heterofl_tpu.parallel.pod import (bitwise_match,
+                                                       run_pod_probe)
+
+                hb("[pod] 2-process distributed probe + 1-process reference")
+                pod_root = tempfile.mkdtemp(prefix="bench_pod_")
+                ref_dir = os.path.join(pod_root, "ref")
+                pod_dir = os.path.join(pod_root, "pod")
+                ref = run_pod_probe(ref_dir, n_processes=1,
+                                    local_devices=8, k=4, align=2)
+                pod = run_pod_probe(pod_dir, n_processes=2,
+                                    local_devices=4, k=4)
+                match = bitwise_match(pod_dir, ref_dir)
+                pod_ab.update({
+                    "processes": pod[0]["processes"],
+                    "devices": pod[0]["devices"],
+                    "k": pod[0]["k"],
+                    "rounds_per_sec": round(pod[0]["rounds_per_sec"], 4),
+                    "ref_rounds_per_sec": round(ref[0]["rounds_per_sec"], 4),
+                    "ckpt_write_s": [round(r["ckpt_write_s"], 4)
+                                     for r in pod],
+                    "ckpt_shard_write_s": [round(r["ckpt_shard_write_s"], 4)
+                                           for r in pod],
+                    "dcn_axes": pod[0]["dcn_axes"],
+                    "wire": pod[0]["wire"],
+                    "reshards": pod[0]["reshards"],
+                    "dcn_one_reduction": pod[0]["dcn_one_reduction"],
+                    "bitwise_vs_single_process": match["match"],
+                })
+                if not match["match"]:
+                    pod_ab.update({
+                        "error": "2-process run is NOT bitwise-identical "
+                                 "to the 1-process reference",
+                        "mismatches": match["mismatches"][:20]})
+            except Exception as e:
+                pod_ab.update({"error": repr(e)})
+                print(f"bench: pod probe failed: {e!r}", file=sys.stderr)
         emit(ctx, timed_rounds, strategies=strategies or None)
 
 

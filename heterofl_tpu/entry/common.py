@@ -57,9 +57,12 @@ from ..utils import (
     Logger,
     checkpoint_path,
     copy_best,
+    dense_from_blocks,
+    is_shard_marker,
     make_scheduler,
     resume,
     save_checkpoint,
+    save_checkpoint_sharded,
     summarize_sums,
 )
 from ..utils.optim import PlateauScheduler
@@ -106,6 +109,43 @@ def cfg_from_args(args: argparse.Namespace) -> Dict[str, Any]:
     if getattr(args, "control_name", None) and args.control_name != "None":
         cfg["control"] = C.parse_control_name(args.control_name)
     return cfg
+
+
+# ---------------------------------------------------------------------------
+# multi-host resume consistency (ISSUE 17 satellite: tested directly)
+# ---------------------------------------------------------------------------
+
+def check_multihost_resume(blob: Optional[Dict[str, Any]]) -> int:
+    """Verify every process resumed the SAME checkpoint state and return
+    the agreed epoch.
+
+    Sharded checkpoints load through the shared filesystem (the header
+    names every process's shard file), so hosts given per-host LOCAL
+    ``output_dir``\\ s diverge immediately: hosts 1..k see no blob (or a
+    stale one) while process 0 resumes -- and the runs silently split into
+    different round counts.  A cross-host broadcast of process 0's epoch
+    catches that before any training dispatch.  No-op (returns this
+    process's epoch) on a single-process runtime."""
+    mine = int(blob.get("epoch", 0) if blob else 0)
+    if jax.process_count() <= 1:
+        return mine
+    from jax.experimental import multihost_utils
+
+    epoch0 = int(multihost_utils.broadcast_one_to_all(jnp.int32(mine)))
+    if mine != epoch0:
+        raise RuntimeError(
+            f"resume state differs across hosts (process 0 at epoch "
+            f"{epoch0}, this host at {mine}): output_dir must be a "
+            f"shared filesystem for multi-host resume")
+    return epoch0
+
+
+def _restore_params(blob_params: Dict[str, Any]) -> Dict[str, Any]:
+    """Checkpointed params -> device trees: shard-blocks markers (written
+    by a multi-process run) densify from the merged block set first, so a
+    blob restores onto ANY process count."""
+    return {k: jnp.asarray(dense_from_blocks(v) if is_shard_marker(v) else v)
+            for k, v in blob_params.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -515,14 +555,10 @@ class FedExperiment:
                     "multiplexed loop does not build the TraceRecorder, "
                     "so the trace would be silently empty (a ROADMAP "
                     "follow-on; per-arm probes/watchdog DO run)")
-            if "arms" in self.mesh.axis_names and jax.process_count() > 1:
-                raise ValueError(
-                    "the arms mesh placement cannot run multi-process "
-                    "yet: params commit sharded over the arms axis and "
-                    "the checkpoint path materializes them with "
-                    "np.asarray, which needs fully-addressable arrays (a "
-                    "ROADMAP follow-on; the vmap placement replicates "
-                    "and works on pods)")
+            # arms-mesh multi-process runs are supported since ISSUE 17:
+            # staging commits through commit_global (GSPMD NamedSharding
+            # assembly) and the checkpoint path writes per-process shard
+            # files for non-addressable leaves (save_checkpoint_sharded)
         self._eval_widx = None  # rolling Local-eval window currently staged
         self._fused = None  # FusedEval, built on first eval-bearing superstep
         self.alt_engine = None
@@ -1240,20 +1276,7 @@ class FedExperiment:
     def run(self, pivot_metric: str, pivot_mode: str = "max") -> Dict[str, Any]:
         cfg = self.cfg
         blob = resume(cfg["output_dir"], self.tag, cfg["resume_mode"])
-        if jax.process_count() > 1:
-            # checkpoints are written by process 0 only, so resume requires a
-            # SHARED output_dir; detect per-host local dirs (hosts 1..k see no
-            # blob) before they diverge into different round counts
-            from jax.experimental import multihost_utils
-
-            epoch0 = int(multihost_utils.broadcast_one_to_all(
-                jnp.int32(blob.get("epoch", 0) if blob else 0)))
-            mine = int(blob.get("epoch", 0) if blob else 0)
-            if mine != epoch0:
-                raise RuntimeError(
-                    f"resume state differs across hosts (process 0 at epoch "
-                    f"{epoch0}, this host at {mine}): output_dir must be a "
-                    f"shared filesystem for multi-host resume")
+        check_multihost_resume(blob)
         if blob and "data_split" in blob and blob["data_split"] is not None:
             data_split, label_split = blob["data_split"], blob["label_split"]
         else:
@@ -1275,7 +1298,7 @@ class FedExperiment:
             self.phase_timer.trace = self.tracer
         pivot = -float("inf") if pivot_mode == "max" else float("inf")
         if blob:
-            params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+            params = _restore_params(blob["params"])
             if blob.get("wire_resid") is not None:
                 # resume the wire codec's error-feedback carry (ISSUE 8):
                 # without it the first resumed round re-loses the residual a
@@ -1558,16 +1581,18 @@ class FedExperiment:
             "scheduler_state": self.scheduler.state_dict()
             if hasattr(self.scheduler, "state_dict") else None,
         }
-        # multi-host: params/metrics are replicated, so only process 0
-        # writes (every host writing the same file corrupts shared
-        # filesystems; harmless no-op on a single host)
+        # multi-host: the sharded writer is COLLECTIVE -- every process
+        # calls it; replicated-only blobs degenerate to the process-0
+        # plain write, process-local leaves (the slices EF carry) land in
+        # per-process shard files named by the header (ISSUE 17)
         if jax.process_index() == 0:
             self._chaos("checkpoint")
-            with self._trace_span("checkpoint", {"epoch": int(epoch)}):
-                save_checkpoint(checkpoint_path(cfg["output_dir"], self.tag),
-                                blob_out, keep=self.checkpoint_keep)
-                if is_best:
-                    copy_best(cfg["output_dir"], self.tag)
+        with self._trace_span("checkpoint", {"epoch": int(epoch)}):
+            save_checkpoint_sharded(
+                checkpoint_path(cfg["output_dir"], self.tag),
+                blob_out, keep=self.checkpoint_keep)
+            if is_best and jax.process_index() == 0:
+                copy_best(cfg["output_dir"], self.tag)
         logger.reset()
         # a clean iteration ending in a durable checkpoint proves recovery:
         # the rollback budget re-arms for the next (independent) incident
@@ -1699,6 +1724,7 @@ class ArmsExperiment(FedExperiment):
         E = self.arms_spec.count
         tag = self._arms_tag()
         blob = resume(cfg["output_dir"], tag, cfg["resume_mode"])
+        check_multihost_resume(blob)
         if blob and blob.get("data_split") is not None:
             data_split, label_split = blob["data_split"], blob["label_split"]
         else:
@@ -1712,7 +1738,7 @@ class ArmsExperiment(FedExperiment):
         pivots = [(-float("inf") if pivot_mode == "max" else float("inf"))
                   for _ in range(E)]
         if blob:
-            params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+            params = _restore_params(blob["params"])
             epoch = blob.get("epoch", 1)
             pivots = blob.get("arm_pivots", pivots)
             if blob.get("wire_resid") is not None:
@@ -1774,6 +1800,12 @@ class ArmsExperiment(FedExperiment):
                             self._arm_scheds[e].step_metric(
                                 g.get("Global-Loss", 0.0))
             epoch_end = epoch + k - 1
+            # per-arm exportable blobs need HOST arm slices; on an arms-
+            # sharded multi-process mesh that is a collective gather (every
+            # process executes it in lockstep -- checkpoint boundary only,
+            # never round-path wire), on a single process a plain D2H
+            from ..parallel.staging import host_fetch
+            host_params = {kk: host_fetch(v) for kk, v in params.items()}
             for e in range(E):
                 g = evaluated[e]
                 cur = g.get(pivot_metric) if g else None
@@ -1790,7 +1822,7 @@ class ArmsExperiment(FedExperiment):
                     "lr_scale": self.arms_spec.lr_scales[e],
                     "epoch": epoch_end + 1,
                     "params": {kk: np.asarray(v[e])
-                               for kk, v in params.items()},
+                               for kk, v in host_params.items()},
                     "pivot": pivots[e],
                 }
                 if jax.process_index() == 0:
@@ -1810,9 +1842,10 @@ class ArmsExperiment(FedExperiment):
                 "arm_scheds": ([s.state_dict() for s in self._arm_scheds]
                                if self._arm_scheds else None),
             }
-            if jax.process_index() == 0:
-                save_checkpoint(checkpoint_path(cfg["output_dir"], tag),
-                                blob_out, keep=self.checkpoint_keep)
+            # collective: arms-sharded params land in per-process shard
+            # files; replicated blobs degenerate to the process-0 write
+            save_checkpoint_sharded(checkpoint_path(cfg["output_dir"], tag),
+                                    blob_out, keep=self.checkpoint_keep)
             logger.safe(False)
             epoch = epoch_end + 1
         return {"params": params, "arms": self.arms_spec, "pivots": pivots,

@@ -252,21 +252,86 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                     "pipeline stages ONE schedule's shards per superstep "
                     "(a ROADMAP follow-on)")
         if self.level_placement == "slices":
-            if jax.process_count() > 1:
-                # slice boundaries are not host-aligned yet: a level whose
-                # rows all belong to another process would wedge multi-
-                # controller dispatch -- fall back to span until verified
-                import warnings
+            # multi-process meshes take the host-aligned partition (ISSUE
+            # 17): level boundaries snap to process boundaries, so every
+            # level's rows land on disjoint hosts and the fused switch
+            # branches stay uniform per device row
+            self._slices, refusal = self._static_mesh_slices()
+            if not self._slices:
+                self._refuse_slices(refusal)
 
-                warnings.warn("level_placement='slices' is single-process "
-                              "only for now; falling back to 'span'")
-                self.level_placement = "span"
-            else:
-                self._slices = self._static_mesh_slices()
-                if not self._slices:
-                    self.level_placement = "span"
+    def _refuse_slices(self, reason: str) -> None:
+        """Loud span fallback (ISSUE 17 satellite): a configured slices
+        placement that cannot be honoured names WHY -- a structured
+        warning by default, a :class:`ValueError` under
+        ``cfg['strict_placement']`` (operators pinning the pod layout want
+        the dispatch refused, not silently reshaped)."""
+        import json as _json
+        import warnings
 
-    def _static_mesh_slices(self) -> Dict[float, Tuple[int, int]]:
+        detail = _json.dumps({"event": "slices-fallback", "reason": reason,
+                              "clients_rows": int(self.mesh.shape["clients"]),
+                              "processes": int(jax.process_count())},
+                             sort_keys=True)
+        if self.cfg.get("strict_placement"):
+            raise ValueError(
+                f"level_placement='slices' cannot be honoured and "
+                f"strict_placement is set: {reason} ({detail})")
+        warnings.warn(f"level_placement='slices' falling back to 'span': "
+                      f"{reason} ({detail})")
+        self.level_placement = "span"
+
+    def _clients_row_chunks(self) -> Optional[List[Tuple[int, int]]]:
+        """The contiguous clients-row chunks level boundaries may land on:
+        single rows on a single-process mesh, whole per-process row blocks
+        on a multi-process mesh (derived from the MESH devices'
+        ``process_index`` -- the same signal
+        ``staticcheck.wire.dcn_axes_of`` classifies DCN-eligible axes from,
+        so AOT topology meshes get host-aligned chunks too).  ``None`` when
+        no host-aligned partition exists: a clients row straddling
+        processes, or a process owning non-contiguous row ranges.
+
+        ``cfg['slice_align']`` (int n > 0) forces allocation units of
+        ``C/n`` contiguous rows instead -- the single-process reference run
+        emulating a pod's per-process blocks (the bitwise probe,
+        :mod:`~.pod`).  The forced boundaries must contain every process
+        boundary, so a forced unit never straddles hosts."""
+        # staticcheck: allow(no-asarray): constructor-time mesh introspection
+        devs = np.asarray(self.mesh.devices)
+        C = devs.shape[0]
+        row_proc = []
+        for i in range(C):
+            procs = {getattr(d, "process_index", 0)
+                     for d in np.ravel(devs[i])}
+            if len(procs) > 1:
+                return None
+            row_proc.append(next(iter(procs)))
+        if len(set(row_proc)) <= 1:
+            chunks = [(i, i + 1) for i in range(C)]
+            proc_bounds = {C}  # one process: no internal boundaries
+        else:
+            chunks, lo = [], 0
+            for i in range(1, C):
+                if row_proc[i] != row_proc[i - 1]:
+                    chunks.append((lo, i))
+                    lo = i
+            chunks.append((lo, C))
+            if len({row_proc[c_lo] for c_lo, _ in chunks}) != len(chunks):
+                return None  # a process owns non-contiguous row ranges
+            proc_bounds = {hi for _, hi in chunks}
+        align = int(self.cfg.get("slice_align") or 0)
+        if align > 0:
+            if C % align:
+                return None
+            unit = C // align
+            forced = [(i * unit, (i + 1) * unit) for i in range(align)]
+            if not proc_bounds <= {hi for _, hi in forced}:
+                return None  # a forced unit would straddle a process block
+            chunks = forced
+        return chunks
+
+    def _static_mesh_slices(self
+                            ) -> Tuple[Dict[float, Tuple[int, int]], str]:
         """Allocate clients-axis device rows to levels once per experiment,
         in proportion to EXPECTED FLOP share: fix mode weights each level by
         its user count, dynamic mode by its sampling proportion, both times
@@ -277,13 +342,28 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         terms: input-channel convs, norms, the width-independent data prep).
         Static allocation keeps program cache keys bound to fixed (lo, hi)
         device ranges -- per-round count fluctuation is absorbed by slot
-        bucketing inside each slice.  Empty dict when rows < levels (span
-        fallback)."""
+        bucketing inside each slice.
+
+        Allocation happens in units of :meth:`_clients_row_chunks` -- rows
+        on one process, whole per-process row blocks on a pod (ISSUE 17)
+        -- so every level boundary is host-aligned by construction.
+        Returns ``(slices, refusal_reason)``: an empty dict plus the reason
+        when no partition exists (the caller falls back to span LOUDLY)."""
         cfg = self.cfg
-        C = self.mesh.shape["clients"]
         level_rates = sorted(self.levels, reverse=True)
-        if C < len(level_rates) or len(level_rates) <= 1:
-            return {}
+        if len(level_rates) <= 1:
+            return {}, "a single level leaves nothing to slice"
+        chunks = self._clients_row_chunks()
+        if chunks is None:
+            return {}, ("no host-aligned partition exists: a clients row "
+                        "straddles process boundaries (or a process owns "
+                        "non-contiguous rows) on this mesh")
+        if len(chunks) < len(level_rates):
+            unit = ("process-aligned row chunks" if jax.process_count() > 1
+                    else "clients rows")
+            return {}, (f"{len(chunks)} {unit} cannot host "
+                        f"{len(level_rates)} levels (each level needs at "
+                        f"least one)")
         if cfg["model_split_mode"] == "fix":
             vec = np.asarray(cfg["model_rate"], np.float64)  # staticcheck: allow(no-asarray): constructor-time config parse
             weights = [float((vec == r).sum()) for r in level_rates]  # staticcheck: allow(no-float-coercion): host config parse
@@ -297,17 +377,19 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         shares = np.array([w * table[r] for w, r in zip(weights, level_rates)],
                           np.float64)
         shares = np.maximum(shares, 1e-9)
-        rows = np.maximum(1, np.floor(shares / shares.sum() * C)).astype(int)
-        while rows.sum() > C:  # the >=1 floor can overshoot with many levels
+        n_units = len(chunks)
+        rows = np.maximum(1, np.floor(shares / shares.sum()
+                                      * n_units)).astype(int)
+        while rows.sum() > n_units:  # the >=1 floor can overshoot
             cand = int(np.argmax(np.where(rows > 1, rows, -1)))
             rows[cand] -= 1
-        while rows.sum() < C:  # leftovers go to the most loaded level
+        while rows.sum() < n_units:  # leftovers go to the most loaded level
             rows[int(np.argmax(shares / rows))] += 1
-        out, lo = {}, 0
+        out, ulo = {}, 0
         for r, n in zip(level_rates, rows):
-            out[r] = (lo, lo + int(n))
-            lo += int(n)
-        return out
+            out[r] = (chunks[ulo][0], chunks[ulo + int(n) - 1][1])
+            ulo += int(n)
+        return out, ""
 
     # -- per-level codec layout (ISSUE 9 satellite) --------------------
 
@@ -607,6 +689,15 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                 "'stream'): the K=1 path splits the round across L+1 "
                 "host-orchestrated programs with no shared round core to "
                 "probe")
+        if self.level_placement == "slices" and jax.process_count() > 1:
+            raise ValueError(
+                "level_placement='slices' on a multi-process mesh needs "
+                "the fused superstep (set superstep_rounds > 1 or "
+                "client_store='stream'): the K=1 host-orchestrated path "
+                "dispatches each level onto its own sub-mesh, and a "
+                "process with no devices in a level's slice cannot join "
+                "that dispatch -- the fused program runs every level on "
+                "the FULL mesh behind one lax.switch")
         timer = timer if timer is not None else PhaseTimer()
         n_dev = self.mesh.shape["clients"]
         with timer.phase("stage"):
@@ -713,12 +804,17 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                                              eng0.batch_size)
 
     def _fused_layout(self):
-        """(mode, level boundary table) of the fused round: 'slices' when
-        the static row partition exists and there is no data axis (a
-        collective inside a ``lax.switch`` branch is not uniform across
-        devices), else 'span'."""
-        if self.level_placement == "slices" and self._slices \
-                and self.mesh.shape["data"] == 1:
+        """(mode, level boundary table) of the fused round: 'slices'
+        whenever the static row partition exists, else 'span'.
+
+        A data axis no longer refuses slices mode (ISSUE 17): the branch
+        index is a function of ``axis_index("clients")`` alone, so every
+        device sharing a clients row -- the participant set of every
+        data-axis collective inside a branch -- takes the SAME branch.
+        Each collective's replica groups are therefore uniform (a group
+        either enters its level's branch together or skips it together),
+        which is the only uniformity XLA's grouped collectives need."""
+        if self.level_placement == "slices" and self._slices:
             return "slices", [self._slices[r][0] for r in sorted(self._slices, reverse=True)]
         return "span", None
 
@@ -943,8 +1039,8 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
                         def f(p_, key_l, lr_l, u_, rs_):
                             s_l, c_l, ms_l = self._level_core(
                                 rate_own, p_, key_l, lr_l, u_,
-                                tuple(d) if streaming else data, 1, None,
-                                local_data=streaming, epoch=t)
+                                tuple(d) if streaming else data, n_data,
+                                data_axis, local_data=streaming, epoch=t)
                             spec_o = lay["specs"][rate_own]
                             sf, cf = spec_o.flatten(s_l), spec_o.flatten(c_l)
                             payload = {f"L{lz}": zero_tree(rz)
@@ -1085,10 +1181,14 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
 
                     def mk(rate):
                         def f(p_, key_l, lr_l, u_):
+                            # n_data/data_axis pass through (ISSUE 17): the
+                            # data-axis collectives inside this branch are
+                            # uniform per clients row -- every participant
+                            # of a row's "data" group takes the same branch
                             s, c, m = self._level_core(
                                 rate, p_, key_l, lr_l, u_,
-                                tuple(d) if streaming else data, 1, None,
-                                local_data=streaming, epoch=t)
+                                tuple(d) if streaming else data, n_data,
+                                data_axis, local_data=streaming, epoch=t)
                             return embed(s, rate), embed(c, rate), m
                         return f
 

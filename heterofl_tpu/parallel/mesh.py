@@ -77,6 +77,14 @@ def initialize_distributed() -> bool:
             "JAX_NUM_PROCESSES and JAX_PROCESS_ID must be set together "
             f"(got JAX_NUM_PROCESSES={num!r}, JAX_PROCESS_ID={pid!r})")
     local = os.environ.get("JAX_LOCAL_DEVICE_IDS")
+    # the XLA:CPU backend runs cross-process collectives only through an
+    # explicit collectives layer (gloo); without it every multi-process
+    # dispatch dies with "Multiprocess computations aren't implemented on
+    # the CPU backend" -- select it before the backend initialises (the
+    # 2-process CPU probe, tests/test_pod.py; harmless for TPU runs where
+    # the platform is not cpu)
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        _jax.config.update("jax_cpu_collectives_implementation", "gloo")
     _jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=int(num) if num is not None else None,

@@ -67,6 +67,69 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def commit_global(x, sharding: NamedSharding):
+    """Commit a host (or single-device) value onto ``sharding`` on a mesh
+    that may span multiple processes (ISSUE 17).
+
+    ``jax.device_put`` can only target addressable devices; on a
+    multi-controller mesh the committed array must be assembled from every
+    process's local shards instead.  Each process calls this with the SAME
+    host value (staging inputs are computed identically everywhere -- the
+    single-controller-per-process GSPMD contract) and contributes the
+    shards its devices own via ``jax.make_array_from_callback``.  On a
+    fully-addressable (single-process) mesh this is exactly the explicit
+    ``device_put`` the transfer guard blesses, so the steady-state path is
+    unchanged."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        # already a global array on this multi-process runtime: an explicit
+        # jitted reshard (a collective program; all processes call this in
+        # lockstep at staging boundaries)
+        fn = _RESHARDERS.get(sharding)
+        if fn is None:
+            # staticcheck: allow(jit-needs-donation): staging-boundary
+            # reshard copy; the source stays live with the caller
+            fn = jax.jit(lambda t: t + 0, out_shardings=sharding)
+            _RESHARDERS[sharding] = fn
+        return fn(x)
+    # staticcheck: allow(no-asarray): multi-process staging commit -- the
+    # callback below hands device_put-equivalent host slices to the runtime
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
+_GATHERERS: Dict[Any, Any] = {}
+_RESHARDERS: Dict[Any, Any] = {}
+
+
+def host_fetch(a):
+    """Host copy of a committed array that may not be fully addressable
+    (multi-process meshes, ISSUE 17).
+
+    Fully-addressable arrays (every single-process mesh) take the plain
+    ``np.asarray`` D2H path.  A fully-replicated multi-process array reads
+    its local replica.  A SHARDED multi-process array is first reshard-
+    gathered to replicated by a jitted identity with explicit
+    ``out_shardings`` -- a collective program, so every process must call
+    this in lockstep (the metric-fetch and checkpoint boundaries both do)."""
+    if not isinstance(a, jax.Array) or a.is_fully_addressable:
+        # staticcheck: allow(no-asarray): checkpoint/metric-boundary D2H
+        return np.asarray(a)
+    if a.is_fully_replicated:
+        # staticcheck: allow(no-asarray): local-replica read, no collective
+        return np.asarray(a.addressable_data(0))
+    mesh = a.sharding.mesh
+    fn = _GATHERERS.get(mesh)
+    if fn is None:
+        # staticcheck: allow(jit-needs-donation): checkpoint-boundary gather
+        # copy; donating would free the caller's live carry/metric buffer
+        fn = jax.jit(lambda t: t + 0, out_shardings=NamedSharding(mesh, P()))
+        _GATHERERS[mesh] = fn
+    # staticcheck: allow(no-asarray): replicated local-replica read
+    return np.asarray(fn(a).addressable_data(0))
+
+
 class PlacementCache:
     """Once-per-experiment placement of operands onto a mesh or its slices.
 
@@ -108,7 +171,7 @@ class PlacementCache:
         if hit is not None and hit[0] == src:
             return hit[2]
         sh = NamedSharding(self.mesh_for(srange), spec)
-        out = tuple(jax.device_put(a, sh) for a in arrays)
+        out = tuple(commit_global(a, sh) for a in arrays)
         self._placed[key] = (src, tuple(arrays), out)
         return out
 
@@ -125,8 +188,8 @@ class PlacementCache:
         # staticcheck: allow(no-float-coercion): THE blessed scalar staging
         # path -- host value compare + one explicit put
         if hit is None or hit[0] != float(value):
-            arr = jax.device_put(np.asarray(value, dtype),  # staticcheck: allow(no-asarray): explicit staging put
-                                 NamedSharding(self.mesh_for(srange), P()))
+            arr = commit_global(np.asarray(value, dtype),  # staticcheck: allow(no-asarray): explicit staging put
+                                NamedSharding(self.mesh_for(srange), P()))
             self._scalars[slot] = (float(value), arr)  # staticcheck: allow(no-float-coercion): host cache key
             return arr
         return hit[1]
@@ -150,7 +213,7 @@ class PlacementCache:
         def one(a):
             if getattr(a, "sharding", None) == sh and getattr(a, "committed", False):
                 return a
-            return jax.device_put(a, sh)
+            return commit_global(a, sh)
 
         return jax.tree_util.tree_map(one, tree)
 
@@ -174,7 +237,7 @@ class PlacementCache:
         tree = jax.tree_util.tree_map(
             lambda a: a.copy() if isinstance(a, np.ndarray) else a, tree)
         sh = NamedSharding(self.mesh_for(srange), spec)
-        return jax.device_put(tree, sh)
+        return jax.tree_util.tree_map(lambda a: commit_global(a, sh), tree)
 
     def broadcast(self, tree, srange: Optional[Tuple[int, int]] = None):
         """Jitted replicate-copy onto the (sub-)mesh: private buffers that a
@@ -199,7 +262,7 @@ class PlacementCache:
         # two steps: the explicit put moves the data onto the (sub-)mesh (a
         # source committed to a SUPERSET of devices cannot enter the smaller
         # jit), then the jitted copy severs any buffer aliasing
-        return fn(jax.device_put(tree, sh))
+        return fn(jax.tree_util.tree_map(lambda a: commit_global(a, sh), tree))
 
     def memo(self, name: str, sources: Sequence[Any], build: Callable[[], Any]):
         """Generic staged-computation cache (pad-and-commit paths in the
@@ -310,7 +373,7 @@ class PendingMetrics:
 
     def fetch(self):
         if self._host is None:
-            host = jax.tree_util.tree_map(np.asarray, self._tree)
+            host = jax.tree_util.tree_map(host_fetch, self._tree)
             self._host = self._assemble(host) if self._assemble is not None else host
             self._tree = None  # release the device refs
         return self._host
@@ -598,7 +661,7 @@ class CohortStager:
         """Commit one ring slot's buffers to the mesh with ``specs`` and
         return the private device arrays; advances the ring cursor."""
         shardings = tuple(NamedSharding(self.mesh, s) for s in specs)
-        put = tuple(jax.device_put(b, sh) for b, sh in zip(bufs, shardings))
+        put = tuple(commit_global(b, sh) for b, sh in zip(bufs, shardings))
         sig = tuple((b.shape, b.dtype.str, s) for b, s in zip(bufs, specs))
         out = self._copier(sig, shardings)(put)
         self._fences[(key, slot)] = out

@@ -92,9 +92,10 @@ class _SchedBufCarry:
         aggregation or before the first buffered round."""
         if self._sched_buf is None:
             return None
-        # staticcheck: allow(no-asarray): checkpoint-boundary D2H fetch
-        # (superstep boundaries only), not steady-state round code
-        return np.asarray(self._sched_buf)
+        # replicated carry: every process holds the full value, so the
+        # multi-process path reads its local replica (host_fetch)
+        from ..parallel.staging import host_fetch
+        return host_fetch(self._sched_buf)
 
     def set_sched_buf(self, arr) -> None:
         """Restore the staleness buffer from a checkpoint (resume):
@@ -107,8 +108,9 @@ class _SchedBufCarry:
         # normalization; the carry reaches the mesh via the explicit
         # device_put + jitted private copy below
         host = np.asarray(arr, np.float32)
+        from ..parallel.staging import commit_global
         # staticcheck: allow(jit-needs-donation): one-time restore copy
         # severing host-buffer aliasing; donating its input would free the
         # caller's checkpoint array
         self._sched_buf = jax.jit(lambda t: t + 0, out_shardings=sh)(
-            jax.device_put(host, sh))
+            commit_global(host, sh))

@@ -50,6 +50,11 @@ def main(argv=None) -> int:
                              "to 2%%)")
     parser.add_argument("--skip-audit", action="store_true",
                         help="lint only (never imports jax)")
+    parser.add_argument("--aot-v4128", action="store_true",
+                        help="also run the subprocess v4-128 AOT multi-"
+                             "host check (ISSUE 17); records into "
+                             "config.aot_v4128, tries the TPU topology "
+                             "then falls back to a 64-device CPU mesh")
     parser.add_argument("--skip-lint", action="store_true",
                         help="program audit only")
     parser.add_argument("--flop-tol", type=float, default=None,
@@ -98,7 +103,7 @@ def main(argv=None) -> int:
         from .audit import run_audit
 
         report = run_audit(flagship=args.flagship, flop_tol=args.flop_tol,
-                           seed=args.seed)
+                           seed=args.seed, with_aot=args.aot_v4128)
     report.add_lint(lint_findings)
     report.generated_at = datetime.now(timezone.utc).isoformat()
     report.config["argv"] = list(argv) if argv is not None else sys.argv[1:]

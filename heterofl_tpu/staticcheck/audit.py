@@ -111,20 +111,26 @@ EVAL_PSUM_BUDGET = 2
 #: (un-hoisting the masks + un-fusing the epilogue, or any new per-leaf
 #: chain of comparable size) fails the audit the same way a second psum
 #: would.
+#: (re-pinned with ISSUE 17: the current XLA:CPU build fuses the SAME
+#: 159-instruction masked step body into 69 kernels where the previous
+#: build produced 55 -- verified against the pristine pre-ISSUE tree, so
+#: it is toolchain drift, not an op-soup regression.  Headroom stays +5
+#: as before; the reference-op-chain bodies drift proportionally and
+#: remain above the budget.)
 STEP_BODY_FUSION_BUDGET = {
-    "masked/replicated/k1": 60,
+    "masked/replicated/k1": 74,
     "grouped/span/level-1/k1": 66,
     # ISSUE 10: the health probes live at ROUND level (post-psum), never
     # inside the local-step scan body -- the telemetry-on k1 program is
     # held to the SAME step-body budget as its dense twin
-    "masked/replicated/k1-telemetry": 60,
+    "masked/replicated/k1-telemetry": 74,
     # ISSUE 12: the cohort histograms are round-level bucketing over the
     # already-emitted per-slot metric sums -- same unchanged step body
-    "masked/replicated/k1-hist": 60,
+    "masked/replicated/k1-hist": 74,
     # ISSUE 15: the quarantine gate lives at ROUND level (after local
     # training, folded into the counted sums before the psum), never
     # inside the local-step scan body -- same unchanged step body
-    "masked/replicated/k1-quarantine": 60,
+    "masked/replicated/k1-quarantine": 74,
 }
 
 
@@ -526,6 +532,32 @@ def _grouped_targets(setup) -> Tuple[List, Dict[str, float], Any]:
                 (params, key, np.int32(1), coh_sl.sched) + tuple(coh_sl.data),
                 {"donated": n_leaves, "psum": PSUM_BUDGET,
                  "wire_bytes": wire_top,
+                 "mem": _mem_expect(bt, top, coh_sl.per_dev)}))
+
+            # multi-host fake-mesh variants (ISSUE 17): the same fused
+            # slices programs re-audited with the clients axis classified
+            # as crossing process boundaries -- the host-aligned placement
+            # puts levels on disjoint hosts, so every byte the training
+            # round moves cross-host is the ONE dense level-a reduction
+            # (DCN budget enforced by EQUALITY), with zero reshards.
+            # wire_only: the compile-side checks already ran on the
+            # single-process entries above (same program objects).
+            mh = {"dcn_axes": ("clients",), "dcn_budget_bytes": wire_top,
+                  "dcn_exact": True, "wire_only": True}
+            targets.append((
+                "grouped/slices/k8-fused/mh",
+                grp_sl._superstep_prog(k, per_dev_sl, "slices"),
+                (params, key, np.int32(1), _sds((k, per_dev_sl * n_dev))) + data,
+                {"donated": n_leaves, "psum": PSUM_BUDGET,
+                 "wire_bytes": wire_top, **mh,
+                 "mem": _mem_expect(bt, top, per_dev_sl)}))
+            targets.append((
+                "grouped/stream/slices/k8/mh",
+                grp_sl._superstep_prog(k, coh_sl.per_dev, "slices",
+                                       streaming=True),
+                (params, key, np.int32(1), coh_sl.sched) + tuple(coh_sl.data),
+                {"donated": n_leaves, "psum": PSUM_BUDGET,
+                 "wire_bytes": wire_top, **mh,
                  "mem": _mem_expect(bt, top, coh_sl.per_dev)}))
     return targets, level_prog_names, grp_sl
 
@@ -1257,15 +1289,31 @@ def audit_program(name: str, prog, args: Tuple, expect: Dict[str, Any],
                  f"move aggregates through the single psum only")
 
     # wire model (ISSUE 7 tentpole): price every collective bind and hold
-    # the training round to its dense-reduction byte budget
-    rep.wire = program_wire(jaxpr, mesh)
+    # the training round to its dense-reduction byte budget.  Multi-host
+    # variants (ISSUE 17) override the link classification with an
+    # explicit dcn_axes (the fake-mesh audit: classify AS IF the clients
+    # axis crossed processes) and hold DCN to EXACTLY one dense reduction
+    rep.wire = program_wire(jaxpr, mesh, dcn_axes=expect.get("dcn_axes"))
     if "wire_bytes" in expect:
         check_wire(rep, rep.wire, expect["wire_bytes"],
-                   n_eval_points=expect.get("psum_eval", 0) // EVAL_PSUM_BUDGET)
+                   n_eval_points=expect.get("psum_eval", 0) // EVAL_PSUM_BUDGET,
+                   dcn_budget_bytes=expect.get("dcn_budget_bytes", 0),
+                   dcn_exact=expect.get("dcn_exact", False))
 
     if any(f.rule == "no-host-callback" for f in rep.findings):
         # a host callback is fatal on its own AND may refuse to lower under
         # a mesh -- report what the jaxpr walk found and stop here
+        rep.reshards = {"jaxpr": [list(t) for t in jaxpr_reshards],
+                        "total": len(jaxpr_reshards)}
+        return rep
+
+    if expect.get("wire_only"):
+        # multi-host fake-mesh variant (ISSUE 17): the SAME program object
+        # as its single-process entry (lowered, compiled and budgeted
+        # there); this entry re-audits the trace-level wire classification
+        # under the multi-process link model -- dcn_axes forced onto the
+        # clients axis, DCN held to exactly one dense train reduction --
+        # so it skips the duplicate lower/compile
         rep.reshards = {"jaxpr": [list(t) for t in jaxpr_reshards],
                         "total": len(jaxpr_reshards)}
         return rep
@@ -1624,9 +1672,16 @@ def flop_budget_check(report: AuditReport, setup,
 # ---------------------------------------------------------------------------
 
 def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
-              seed: int = 0, with_recompile_check: bool = True) -> AuditReport:
+              seed: int = 0, with_recompile_check: bool = True,
+              with_aot: bool = False) -> AuditReport:
     """The full program audit.  Returns an :class:`AuditReport` (the CLI
-    adds lint findings and serialises to STATICCHECK.json)."""
+    adds lint findings and serialises to STATICCHECK.json).
+
+    ``with_aot`` additionally runs the subprocess v4-128 AOT multi-host
+    check (ISSUE 17) and records it under ``config["aot_v4128"]`` -- a
+    config record, never a program entry, so the ratchet baseline stays
+    environment-stable; a child that RAN and violated the DCN budget
+    still fails the audit."""
     report = AuditReport()
     setup = build_setup(flagship=flagship, seed=seed)
     report.config = {
@@ -1669,6 +1724,18 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
                             f"leak: weak types / python scalars / slot "
                             f"re-bucketing)")
         report.recompile = rc
+    if with_aot:
+        from .aot import aot_v4128_check
+
+        res = aot_v4128_check(flagship=flagship)
+        report.config["aot_v4128"] = res
+        if res.get("available") and res.get("ok") is False:
+            report.fail(res, "aot-dcn",
+                        f"v4-128 AOT audit ({res.get('mode')}): DCN carries "
+                        f"{res.get('dcn_bytes_per_round')} bytes/round "
+                        f"against a budget of exactly "
+                        f"{res.get('budget_bytes')} with "
+                        f"{res.get('reshards_jaxpr')} reshard(s)")
     return report
 
 

@@ -142,7 +142,8 @@ def program_wire(jaxpr, mesh, dcn_axes: Optional[Sequence[str]] = None
 
 
 def check_wire(rep, wire: Dict[str, Any], expected_train_bytes: int,
-               n_eval_points: int, dcn_budget_bytes: int = 0) -> None:
+               n_eval_points: int, dcn_budget_bytes: int = 0,
+               dcn_exact: bool = False) -> None:
     """Enforce the wire budgets on one program report (``rep`` is a
     :class:`~.report.ProgramReport`).
 
@@ -155,8 +156,11 @@ def check_wire(rep, wire: Dict[str, Any], expected_train_bytes: int,
       points moves the identical payload multiset (the sBN + Global pair);
       a lopsided point means an eval reduction forked.
     * ``wire-dcn``: cross-slice bytes within ``dcn_budget_bytes`` (zero on
-      the single-slice audit mesh; the multi-host PR raises it to exactly
-      one train reduction).
+      the single-slice audit mesh).  ``dcn_exact=True`` -- the multi-host
+      variants (ISSUE 17) -- tightens the bound to EQUALITY: DCN must
+      carry exactly one dense level-a reduction per training round,
+      nothing more (a smuggled reshard) and nothing less (the reduction
+      silently left the cross-host axis).
     * ``wire-unbudgeted``: collectives outside the train/eval buckets
       (``pmax``/``pmin``/``reduce_scatter``/``all_gather`` binds, psums
       over other axis sets) move ZERO bytes -- a reduction smuggled past
@@ -192,11 +196,48 @@ def check_wire(rep, wire: Dict[str, Any], expected_train_bytes: int,
                  f"train/eval budgets "
                  f"({[(r['primitive'], r['axes']) for r in others]}): every "
                  f"byte on the wire must ride the budgeted reductions")
-    if wire["dcn_bytes"] > dcn_budget_bytes:
+    if dcn_exact and wire["dcn_bytes"] != dcn_budget_bytes:
+        rep.fail("wire-dcn",
+                 f"{wire['dcn_bytes']} cross-slice (DCN) collective bytes, "
+                 f"budget is EXACTLY {dcn_budget_bytes} (one dense level-a "
+                 f"reduction per training round on a multi-process mesh): "
+                 f"either a second cross-host transfer crept in or the "
+                 f"training reduction left the cross-host axis (axes "
+                 f"{wire['dcn_axes']})")
+    elif wire["dcn_bytes"] > dcn_budget_bytes:
         rep.fail("wire-dcn",
                  f"{wire['dcn_bytes']} cross-slice (DCN) collective bytes, "
                  f"budget is {dcn_budget_bytes}: a reshard or a second "
                  f"cross-slice reduction crept in (axes {wire['dcn_axes']})")
+
+
+def link_split(payload_bytes: int, participants: int,
+               processes: int = 1) -> Dict[str, int]:
+    """Analytic per-link ICI-vs-DCN byte split of one bidirectional-ring
+    all-reduce (ISSUE 17 satellite: ``bench.py``'s ``extra.wire`` record).
+
+    A ring over ``p`` participants has ``p`` links, each carrying the same
+    ``2 (p-1)/p x payload`` bytes (reduce-scatter + all-gather, the
+    :func:`ring_allreduce_bytes` number).  With the participants laid out
+    as ``h`` contiguous per-process blocks (the host-aligned slices
+    placement), exactly ``h`` of those links cross a process boundary --
+    the scarce DCN links (PAPERS.md 2405.20431); the remaining ``p - h``
+    stay on intra-host ICI.  ``processes <= 1`` puts every byte on ICI.
+    Import-light like the rest of the analytic half (no jax)."""
+    p = max(1, int(participants))
+    h = max(1, int(processes))
+    per_link = ring_allreduce_bytes(payload_bytes, p)
+    dcn_links = h if (h > 1 and p > 1) else 0
+    ici_links = (p if p > 1 else 0) - dcn_links
+    return {
+        "participants": p,
+        "processes": h,
+        "bytes_per_link": per_link,
+        "dcn_links": dcn_links,
+        "ici_links": ici_links,
+        "dcn_bytes_total": dcn_links * per_link,
+        "ici_bytes_total": ici_links * per_link,
+    }
 
 
 def codec_round_wire(codec: str, payload_bytes: int, dense_bytes: int,

@@ -2,11 +2,19 @@ from .compile_cache import default_cache_dir, enable_persistent_cache  # noqa: F
 from .checkpoint import (  # noqa: F401
     CheckpointCorruptError,
     checkpoint_path,
+    commit_from_blocks,
     copy_best,
+    dense_from_blocks,
+    host_shard_blocks,
+    is_shard_marker,
     load_checkpoint,
+    load_checkpoint_sharded,
     load_newest_verifying,
+    load_newest_verifying_sharded,
     resume,
     save_checkpoint,
+    save_checkpoint_sharded,
+    shard_path,
 )
 from .logger import Logger  # noqa: F401
 from .metrics import Metric, accuracy, perplexity, summarize_sums  # noqa: F401

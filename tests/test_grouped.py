@@ -180,14 +180,54 @@ def test_mesh_slices_partition():
     assert grp2.level_placement == "span"
 
 
-def test_grouped_slices_multiprocess_fallback(monkeypatch):
-    """Slice boundaries are not host-aligned yet: multi-controller runs
-    must fall back to span with a warning, not wedge dispatch."""
+def test_grouped_slices_multiprocess_k1_refused(monkeypatch):
+    """ISSUE 17: slices no longer falls back on a multi-process mesh -- the
+    host-aligned partition is derived from the MESH devices, so a
+    monkeypatched process_count alone (devices all on process 0) keeps the
+    single-row chunks and the slices placement.  What IS refused
+    multi-process is the K=1 host-orchestrated train_round, which would
+    dispatch each level onto a sub-mesh some processes have no devices in."""
     cfg, ds, data = _vision_setup()
     monkeypatch.setattr(jax, "process_count", lambda: 2)
-    with pytest.warns(UserWarning, match="single-process"):
+    g = GroupedRoundEngine(dict(cfg, level_placement="slices"), make_mesh(8, 1))
+    assert g.level_placement == "slices" and g._slices
+    user_idx = np.array([0, 2, 4, 6], np.int32)
+    rates = np.asarray(cfg["model_rate"], np.float32)[user_idx]
+    with pytest.raises(ValueError, match="fused superstep"):
+        g.train_round(make_model(cfg).init(jax.random.key(0)), user_idx,
+                      rates, data, 0.05, jax.random.key(1))
+
+
+def test_grouped_slices_fallback_is_loud_and_strict_refuses():
+    """ISSUE 17 satellite: an unhonourable slices placement falls back to
+    span with a STRUCTURED warning naming the reason, and raises under
+    strict_placement.  A single-level control leaves nothing to slice --
+    the simplest unhonourable case on any mesh."""
+    cfg, ds, data = _vision_setup(control="1_8_0.5_iid_fix_a1_bn_1_1")
+    with pytest.warns(UserWarning, match="slices-fallback") as rec:
         g = GroupedRoundEngine(dict(cfg, level_placement="slices"), make_mesh(8, 1))
     assert g.level_placement == "span" and not g._slices
+    msg = str(rec[0].message)
+    assert "nothing to slice" in msg and '"processes"' in msg
+    with pytest.raises(ValueError, match="strict_placement"):
+        GroupedRoundEngine(dict(cfg, level_placement="slices",
+                                strict_placement=True), make_mesh(8, 1))
+
+
+def test_grouped_slice_align_partitions_and_refuses():
+    """cfg['slice_align']=n forces C/n equal row units (the single-process
+    pod reference): boundaries land only on multiples of C/n, and a
+    non-divisible n is unhonourable (strict -> ValueError)."""
+    cfg, ds, data = _vision_setup(control="1_8_0.5_iid_fix_a1-b1_bn_1_1")
+    g = GroupedRoundEngine(dict(cfg, level_placement="slices", slice_align=2),
+                           make_mesh(8, 1))
+    assert g.level_placement == "slices"
+    bounds = sorted(hi for _, hi in g._slices.values())
+    assert all(hi % 4 == 0 for hi in bounds), g._slices
+    assert g._clients_row_chunks() == [(0, 4), (4, 8)]
+    with pytest.raises(ValueError, match="strict_placement"):
+        GroupedRoundEngine(dict(cfg, level_placement="slices", slice_align=3,
+                                strict_placement=True), make_mesh(8, 1))
 
 
 @pytest.mark.slow

@@ -327,9 +327,20 @@ def test_wire_memory_reshard_sections_on_every_program(audit_report):
                   for c in LOSSY_CODECS}
     for name, p in audit_report.programs.items():
         assert p.wire is not None, name
-        assert p.memory is not None, name
         assert p.reshards is not None and p.reshards["total"] == 0, name
-        assert p.wire["dcn_bytes"] == 0, name  # single-slice audit mesh
+        if name.endswith("/mh"):
+            # ISSUE 17 multi-host variants: the fake 2-process grid puts
+            # the clients axis on DCN -- the whole (one-reduction) train
+            # payload crosses, and NOTHING else does.  These entries
+            # re-audit the SAME program as their single-process twin
+            # under the multi-process link model only (wire_only), so
+            # they carry no duplicate memory/step-body sections.
+            assert p.wire["dcn_bytes"] == p.wire["train_bytes_per_round"], name
+            assert p.wire["other_bytes"] == 0, name
+            assert p.memory is None, name
+        else:
+            assert p.memory is not None, name
+            assert p.wire["dcn_bytes"] == 0, name  # single-slice audit mesh
         codec = next((c for c in LOSSY_CODECS if name.endswith(f"-{c}")), None)
         if name == "grouped/span/combine":
             assert p.wire["train_bytes_per_round"] == 0

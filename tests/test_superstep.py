@@ -305,21 +305,22 @@ def test_superstep_grouped_dynamic_mode():
         assert np.isfinite(ss_ms[r]["loss_sum"]).all()
 
 
-def test_grouped_fused_slices_falls_back_with_data_axis():
-    """A collective inside a lax.switch branch is not uniform across
-    devices, so the fused slices layout requires data=1 -- with a data axis
-    the superstep runs the span-fused program instead."""
+def test_grouped_fused_slices_keeps_slices_with_data_axis():
+    """ISSUE 17 lifted the old data-axis refusal: the fused slices program
+    is now expressed with GSPMD NamedSharding placement (not shard_map), so
+    the per-level collectives stay uniform per device row and a data axis
+    no longer forces the span fallback."""
     # 3 levels so a 4-row clients axis still admits the slices partition
     cfg, ds, data = _vision_setup(control="1_8_0.5_iid_fix_a1-b1-c1_bn_1_1")
     cfg = dict(cfg, level_placement="slices")
     g = GroupedRoundEngine(cfg, make_mesh(4, 2))
-    assert g.level_placement == "slices"  # the sequential path keeps slices
-    mode, _ = g._fused_layout()
-    assert mode == "span"
-    # without the data axis the fused layout IS the slices partition
+    assert g.level_placement == "slices"
+    mode, los = g._fused_layout()
+    assert mode == "slices" and los[0] == 0
+    # and without the data axis, same partition
     g2 = GroupedRoundEngine(cfg, make_mesh(4, 1))
-    mode2, los = g2._fused_layout()
-    assert mode2 == "slices" and los[0] == 0
+    mode2, los2 = g2._fused_layout()
+    assert mode2 == "slices" and los2[0] == 0
 
 
 # ---------------------------------------------------------------------------
