@@ -83,6 +83,12 @@ def resolve_poison_cfg(cfg: Dict[str, Any]) -> Optional[np.ndarray]:
             raise ValueError(f"Not valid chaos_poison entry: {item!r} "
                              f"(a [round >= 0, uid >= 0] int pair)")
         table.append((int(item[0]), int(item[1])))
+    # poison x engine cross-check (ISSUE 18): promoted from the driver.
+    if (cfg.get("strategy", "masked") or "masked") == "sliced":
+        raise ValueError(
+            "Not valid chaos_poison with strategy='sliced': the sliced "
+            "debug twin has no in-program update to poison -- use a "
+            "mesh-native strategy ('masked' or 'grouped')")
     return np.asarray(table, np.int32)
 
 

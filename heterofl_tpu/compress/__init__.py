@@ -127,7 +127,7 @@ def normalize_codec_map(raw: Dict[Any, Any]) -> Dict[float, str]:
     return out
 
 
-def resolve_codec_cfg(cfg: Dict[str, Any]):
+def resolve_codec_cfg(cfg: Dict[str, Any], engine_strategy: str = None):
     """Validate ``cfg['wire_codec']`` / ``cfg['error_feedback']`` and return
     ``(codec, error_feedback)`` -- ``codec`` is a name, or a normalized
     ``{rate: name}`` per-level map (ISSUE 9 satellite; grouped engine's
@@ -136,7 +136,18 @@ def resolve_codec_cfg(cfg: Dict[str, Any]):
     Loud ``ValueError`` on unknown values (the PR 6 convention: stale or
     typo'd config keys fail at validation, never as silent defaults
     mid-run).  ``error_feedback`` defaults True and only matters for lossy
-    codecs."""
+    codecs.
+
+    ``engine_strategy`` is the engine-direct re-validation hook: an engine
+    constructor passes its own identity and gets codec-local validation
+    only (names, map shape, error_feedback).  The strategy-coupled
+    cross-checks below belong to the config-RESOLUTION path alone: the
+    caller of an engine class picked the strategy (whatever
+    ``cfg['strategy']`` says), drives ``k`` per ``train_superstep`` call
+    (``cfg['superstep_rounds']`` binds only the driver's schedule), and
+    the engines keep their own placement refusals -- the masked engine
+    refuses a per-level map at dispatch, the grouped engine checks map
+    keys against its level table."""
     name = cfg.get("wire_codec", "dense") or "dense"
     if isinstance(name, dict):
         name = normalize_codec_map(name)
@@ -150,6 +161,35 @@ def resolve_codec_cfg(cfg: Dict[str, Any]):
         raise ValueError(f"Not valid error_feedback: {ef!r} (must be a bool; "
                          f"it gates the residual re-injection of lossy wire "
                          f"codecs)")
+    if engine_strategy is not None:
+        return name, ef
+    # codec x engine cross-checks (ISSUE 18): promoted from the driver so
+    # a codec the engines cannot lower refuses at config resolution, not
+    # at experiment construction.  This validator OWNS the codec axis in
+    # the staticcheck config lattice.
+    strategy = cfg.get("strategy", "masked") or "masked"
+    if isinstance(name, dict) and strategy != "grouped":
+        raise ValueError(
+            f"Not valid wire_codec: a per-level map needs strategy="
+            f"'grouped' (its fused superstep compresses each level's "
+            f"sliced payload under that level's codec), got strategy="
+            f"{strategy!r}")
+    if name != "dense":
+        if strategy == "sliced":
+            raise ValueError(
+                f"Not valid wire_codec={name!r} with strategy='sliced': "
+                f"the sliced debug twin aggregates on the host, there is "
+                f"no psum to compress -- use a mesh-native strategy "
+                f"('masked' or 'grouped')")
+        if strategy == "grouped" \
+                and int(cfg.get("superstep_rounds", 1) or 1) <= 1 \
+                and (cfg.get("client_store", "eager") or "eager") != "stream":
+            raise ValueError(
+                f"Not valid wire_codec={name!r} with strategy='grouped' at "
+                f"superstep_rounds<=1 and client_store='eager': the K=1 "
+                f"host-orchestrated path reduces per level and has no "
+                f"single global psum to compress (set superstep_rounds>1 "
+                f"or client_store='stream')")
     return name, ef
 
 

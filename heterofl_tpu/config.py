@@ -40,6 +40,14 @@ CONTROL_KEYS = (
 NORM_TYPES = ("bn", "in", "ln", "gn", "none")
 MODEL_NAMES = ("conv", "resnet18", "resnet34", "resnet50", "resnet101",
                "resnet152", "transformer")
+# Feature-axis value registries (ISSUE 18): THE declared domains of the
+# engine/placement/store/pod axes, consumed by the axis validators below and
+# by staticcheck's config-lattice pass (staticcheck/lattice.py enumerates
+# every combination and proves it is either audited-green or refused here).
+STRATEGIES = ("masked", "grouped", "sliced")
+DATA_PLACEMENTS = ("replicated", "sharded")
+LEVEL_PLACEMENTS = ("span", "slices")
+CLIENT_STORES = ("eager", "stream")
 VISION_DATASETS = ("MNIST", "FashionMNIST", "EMNIST", "CIFAR10", "CIFAR100")
 FOLDER_DATASETS = ("Omniglot", "ImageNet", "ImageFolder")
 LM_DATASETS = ("PennTreebank", "WikiText2", "WikiText103")
@@ -537,48 +545,183 @@ def process_control(cfg: Dict[str, Any]) -> Dict[str, Any]:
             cfg[k] = {**cfg[k], **v}
         else:
             cfg[k] = v
-    # stale-config lint (ISSUE 8 satellite): unknown wire_codec /
-    # error_feedback values fail HERE, at config validation, with the PR 6
-    # loud-ValueError convention -- never as a silent dense fallback mid-run
+    # stale-config lint (ISSUE 8/18): unknown knob values AND cross-axis
+    # conflicts fail HERE, at config validation, with the PR 6
+    # loud-ValueError convention -- never as a silent fallback or a
+    # mid-run refusal.  The chain below is THE canonical validator order;
+    # staticcheck's config-lattice pass replays it point by point, so a
+    # combination no validator refuses must be audited-green.
+    for _name, fn in validator_chain():
+        fn(cfg)
+    return cfg
+
+
+def validator_chain():
+    """The canonical ``(name, resolve_*)`` validator sequence, in the order
+    ``process_control`` applies it (ISSUE 18).  Axis validators run first
+    (each owning its knob's domain), then the subsystem validators that
+    additionally own that subsystem's cross-axis conflicts.  staticcheck's
+    lattice pass (``staticcheck/lattice.py``) invokes exactly this chain to
+    prove every refused config point raises from exactly one validator at
+    config-resolution time -- keep additions HERE, never as driver-only
+    checks (the lattice classifies a mid-run-only refusal as a finding).
+
+    Every validator is jax-free and takes the full cfg dict; subsystem
+    packages stay import-light so this chain never boots a backend."""
+    from .chaos import resolve_poison_cfg
     from .compress import resolve_codec_cfg
-    from .sched import resolve_schedule_cfg
-
-    resolve_codec_cfg(cfg)
-    resolve_prefetch_depth(cfg)
-    # sampler validation (ISSUE 11): unknown sampler kinds / malformed
-    # sample_horizon fail HERE, never as a silent default-sampler fallback
-    # (fed.sampling is import-light at the top, like sched/ and obs/)
     from .fed.sampling import resolve_sampler_cfg
-
-    resolve_sampler_cfg(cfg)
-    # scheduler validation (ISSUE 9): unknown kinds/keys or a trace whose
-    # user axis disagrees with num_users fail HERE, at config time
-    resolve_schedule_cfg(cfg)
-    resolve_eval_cohort(cfg)
-    # telemetry/ledger validation (ISSUE 10/12): unknown modes/watchdog
-    # knobs fail here, never as a silent telemetry-off fallback mid-run
+    from .multi import resolve_arms_cfg
     from .obs import (resolve_ledger_cfg, resolve_quarantine_cfg,
                       resolve_telemetry_cfg)
+    from .sched import resolve_schedule_cfg
 
-    resolve_telemetry_cfg(cfg)
-    resolve_ledger_cfg(cfg)
-    # fault-tolerance validation (ISSUE 15): quarantine modes, checkpoint
-    # generation counts and chaos poison tables fail here, at config time
-    # (chaos/ is import-light like sched/ and obs/; checkpoint_keep lives
-    # here -- utils.checkpoint imports jax, and this module's jax-free
-    # import contract must hold for offline tooling)
-    resolve_quarantine_cfg(cfg)
-    resolve_checkpoint_keep(cfg)
-    from .chaos import resolve_poison_cfg
+    return [
+        ("resolve_strategy_cfg", resolve_strategy_cfg),
+        ("resolve_placement_cfg", resolve_placement_cfg),
+        ("resolve_store_cfg", resolve_store_cfg),
+        ("resolve_superstep_cfg", resolve_superstep_cfg),
+        ("resolve_codec_cfg", resolve_codec_cfg),
+        ("resolve_prefetch_depth", resolve_prefetch_depth),
+        ("resolve_sampler_cfg", resolve_sampler_cfg),
+        ("resolve_schedule_cfg", resolve_schedule_cfg),
+        ("resolve_eval_cohort", resolve_eval_cohort),
+        ("resolve_telemetry_cfg", resolve_telemetry_cfg),
+        ("resolve_ledger_cfg", resolve_ledger_cfg),
+        ("resolve_quarantine_cfg", resolve_quarantine_cfg),
+        ("resolve_checkpoint_keep", resolve_checkpoint_keep),
+        ("resolve_poison_cfg", resolve_poison_cfg),
+        ("resolve_arms_cfg", resolve_arms_cfg),
+    ]
 
-    resolve_poison_cfg(cfg)
-    # arms validation (ISSUE 14): malformed counts/seed vectors fail HERE,
-    # never as a silent single-arm fallback mid-run (multi/ is import-light
-    # like sched/ and obs/)
-    from .multi import resolve_arms_cfg
 
-    resolve_arms_cfg(cfg)
-    return cfg
+def resolve_strategy_cfg(cfg: Dict[str, Any]) -> str:
+    """Validate ``cfg['strategy']`` and return it (ISSUE 18).  THE one
+    validator of the engine axis: an unknown strategy fails at config
+    resolution, never as a driver-construction error."""
+    strategy = cfg.get("strategy", "masked") or "masked"
+    if strategy not in STRATEGIES:
+        raise ValueError(f"Not valid strategy: {strategy!r} "
+                         f"(one of {STRATEGIES})")
+    return strategy
+
+
+def resolve_placement_cfg(cfg: Dict[str, Any]):
+    """Validate ``cfg['data_placement']`` / ``cfg['level_placement']`` and
+    return ``(data_placement, level_placement)`` (ISSUE 18).  THE one
+    validator of the placement axis, including its engine cross-checks:
+
+    - ``grouped`` needs replicated data placement (a level's clients span
+      the whole clients axis) -- promoted from the grouped constructor;
+    - ``level_placement='slices'`` is the grouped engine's per-level
+      device partition; the other engines have no level sub-meshes;
+    - the ``sliced`` host twin takes neither placement knob -- previously
+      both were silently ignored (exactly the silent fallback the lattice
+      pass exists to refuse)."""
+    strategy = resolve_strategy_cfg(cfg)
+    dp = cfg.get("data_placement", "replicated") or "replicated"
+    lp = cfg.get("level_placement", "span") or "span"
+    if dp not in DATA_PLACEMENTS:
+        raise ValueError(f"Not valid data_placement: {dp!r} "
+                         f"(one of {DATA_PLACEMENTS})")
+    if lp not in LEVEL_PLACEMENTS:
+        raise ValueError(f"Not valid level_placement: {lp!r} "
+                         f"(one of {LEVEL_PLACEMENTS})")
+    if strategy == "grouped" and dp == "sharded":
+        raise ValueError(
+            "Not valid data_placement='sharded' with strategy='grouped': "
+            "a level's clients span the whole clients axis, so the grouped "
+            "engine packs slot schedules from the replicated store; use "
+            "strategy='masked' for sharded placement")
+    if lp == "slices" and strategy != "grouped":
+        raise ValueError(
+            f"Not valid level_placement='slices' with strategy="
+            f"{strategy!r}: the slices partition assigns each rate level "
+            f"its own clients-axis device rows, which only the grouped "
+            f"engine's per-level dense programs consume")
+    if strategy == "sliced" and dp != "replicated":
+        raise ValueError(
+            f"Not valid data_placement={dp!r} with strategy='sliced': the "
+            f"host-orchestrated debug twin replays the reference loop and "
+            f"ignores device placement -- the knob would silently no-op")
+    return dp, lp
+
+
+def resolve_store_cfg(cfg: Dict[str, Any]) -> str:
+    """Validate ``cfg['client_store']`` and return it (ISSUE 18).  THE one
+    validator of the store axis: unknown modes and the stream x sliced
+    conflict (promoted from the driver) fail at config resolution."""
+    strategy = resolve_strategy_cfg(cfg)
+    store = cfg.get("client_store", "eager") or "eager"
+    if store not in CLIENT_STORES:
+        raise ValueError(f"Not valid client_store: {store!r} "
+                         f"(one of {CLIENT_STORES})")
+    if store == "stream" and strategy == "sliced":
+        raise ValueError(
+            "Not valid client_store='stream' with strategy='sliced': the "
+            "cohort pipeline stages through the mesh-native engines' "
+            "superstep programs ('masked' or 'grouped')")
+    if store == "stream" and cfg.get("data_placement") == "sharded":
+        raise ValueError(
+            "Not valid data_placement='sharded' with client_store="
+            "'stream': the streaming population stages per-superstep "
+            "cohorts through its own placement path, so the sharded "
+            "slot packing would silently no-op -- use replicated")
+    return store
+
+
+def resolve_superstep_cfg(cfg: Dict[str, Any]) -> int:
+    """Validate ``cfg['superstep_rounds']`` and its cross-axis contracts,
+    returning the round count K (ISSUE 18).  THE one validator of the pod
+    axis; the ``metrics_fetch_every`` / Plateau / streaming interplays are
+    promoted from the driver (``entry/common.py``), where they refused at
+    construction -- same typed messages, now at config-resolution time."""
+    raw = cfg.get("superstep_rounds", 1)
+    if raw is None:
+        raw = 1
+    if not isinstance(raw, int) or isinstance(raw, bool) or raw < 1:
+        raise ValueError(f"Not valid superstep_rounds: {raw!r} "
+                         f"(an int >= 1)")
+    K = raw
+    strategy = resolve_strategy_cfg(cfg)
+    store = resolve_store_cfg(cfg)
+    fetch_every = int(cfg.get("metrics_fetch_every", 1) or 1)
+    eval_iv = max(1, int(cfg.get("eval_interval", 1) or 1))
+    if K > 1:
+        if strategy == "sliced":
+            raise ValueError(
+                "Not valid superstep_rounds>1 with strategy='sliced': the "
+                "fused superstep needs a mesh-native engine ('masked' or "
+                "'grouped'); 'sliced' is the host-orchestrated debug twin")
+        if fetch_every != 1 and fetch_every % K:
+            raise ValueError(
+                f"Not valid metrics_fetch_every={fetch_every} with "
+                f"superstep_rounds={K}: a superstep fetches its metrics "
+                f"exactly once per K rounds (use 1 for synchronous fetch "
+                f"or exactly {K}; larger multiples would defer metrics "
+                f"past the superstep's checkpoint)")
+        if fetch_every > K:
+            raise ValueError(
+                f"Not valid metrics_fetch_every={fetch_every} with "
+                f"superstep_rounds={K}: each superstep's eval metrics "
+                f"would be deferred past its checkpoint, silently "
+                f"disabling best-checkpoint tracking (pivot never fresh); "
+                f"use 1 or {K}")
+        if cfg.get("scheduler_name") == "ReduceLROnPlateau" and eval_iv % K:
+            raise ValueError(
+                f"Not valid scheduler_name='ReduceLROnPlateau' with "
+                f"superstep_rounds={K} and eval_interval={eval_iv}: "
+                f"Plateau needs eval boundaries on superstep boundaries "
+                f"(eval_interval % superstep_rounds == 0) -- a "
+                f"mid-superstep eval would require an LR step inside the "
+                f"compiled scan")
+    elif store == "stream" and fetch_every > 1:
+        raise ValueError(
+            f"Not valid metrics_fetch_every={fetch_every} with "
+            f"client_store='stream' at superstep_rounds=1: streaming "
+            f"routes through the (k=1) superstep path, whose "
+            f"best-checkpoint pivot needs a synchronous fetch; use 1")
+    return K
 
 
 def resolve_prefetch_depth(cfg: Dict[str, Any]) -> int:
@@ -628,6 +771,19 @@ def resolve_eval_cohort(cfg: Dict[str, Any]):
         raise ValueError(f"Not valid eval_cohort: {ec} exceeds "
                          f"num_users={users} (drop eval_cohort for "
                          f"whole-population local eval)")
+    # eval-cohort cross-checks (ISSUE 18): promoted from the driver.  This
+    # validator OWNS the eval-cohort axis in the staticcheck lattice.
+    if (cfg.get("client_store", "eager") or "eager") != "stream":
+        raise ValueError(
+            f"Not valid eval_cohort={ec} with client_store='eager': the "
+            f"eager store already densifies the population, so its local "
+            f"eval is O(num_users) either way -- eval_cohort needs "
+            f"client_store='stream'")
+    if cfg.get("model_name") == "transformer":
+        raise ValueError(
+            f"Not valid eval_cohort={ec} with model_name='transformer': "
+            f"eval_cohort samples the per-user Local eval, which only "
+            f"vision experiments run (LM evaluates Global only)")
     return ec
 
 

@@ -85,9 +85,24 @@ def validate_width_geometry(model: ModelDef, cfg: Dict[str, Any]) -> None:
 
 ROUND_RATE_SALT = 7
 USER_SAMPLE_SALT = 11
-#: PRNG salt of the per-arm stream derivation (ISSUE 14): disjoint from
-#: the rate/user salts above and from the engines' 13/98 client streams
-ARM_STREAM_SALT = 17
+#: PRNG salt of the per-arm stream derivation (ISSUE 14), folded into
+#: the HOST key.  Must stay outside the host key's other fold families
+#: (the per-round epoch keys [1, NUM_ROUNDS_BOUND] and the watchdog's
+#: RETRY_SALT window): the old value 17 sat inside the epoch family, so
+#: round 17's key WAS the arms salt root and arm seed 7's stream
+#: collided with round 17's rate stream (staticcheck's key-stream audit
+#: now proves the intervals disjoint).
+ARM_STREAM_SALT = 0x4152  # 16722, past any epoch index
+#: PRNG sub-root salts of the engines' in-round streams (ISSUE 18).  The
+#: per-client slot keys descend from ``fold_in(round_key,
+#: CLIENT_STREAM_SALT)`` and the failure draws from ``fold_in(round_key,
+#: FAILURE_STREAM_SALT)``, so the unbounded uid family lives in its own
+#: subtree: the old flat ``fold_in(round_key, 13 + uid)`` derivation
+#: collided with the failure root at uid 85 (13 + 85 == 98) and with the
+#: deadline salt at uid 118 (13 + 118 == 131) -- at flagship scale
+#: (num_users=100) client 85's stream WAS the failure stream.
+CLIENT_STREAM_SALT = 13
+FAILURE_STREAM_SALT = 98
 
 
 def arm_stream_keys(base_key: jax.Array, seeds) -> jax.Array:
@@ -105,6 +120,26 @@ def arm_stream_keys(base_key: jax.Array, seeds) -> jax.Array:
     salted = jax.random.fold_in(base_key, ARM_STREAM_SALT)
     return jnp.stack([base_key if s is None
                       else jax.random.fold_in(salted, s) for s in seeds])
+
+
+def client_stream_keys(round_key: jax.Array, uids: jnp.ndarray) -> jax.Array:
+    """Stacked per-client slot keys ``fold_in(fold_in(round_key,
+    CLIENT_STREAM_SALT), uid)``: THE one definition of the client stream.
+
+    The masked, grouped and sliced engines all consume this derivation
+    for their local-training keys, which is what keeps the engine
+    equivalence contracts bitwise.  The two-level fold keeps the
+    unbounded uid family in its own subtree (see CLIENT_STREAM_SALT
+    above); staticcheck's key-stream audit pins this shape."""
+    root = jax.random.fold_in(round_key, CLIENT_STREAM_SALT)
+    return jax.vmap(lambda u: jax.random.fold_in(root, u))(jnp.asarray(uids))
+
+
+def failure_stream_key(round_key: jax.Array) -> jax.Array:
+    """The failure-draw root ``fold_in(round_key, FAILURE_STREAM_SALT)``:
+    per-client crash draws fold the uid into THIS key, never into the
+    round key directly (uid subtrees stay disjoint from sibling salts)."""
+    return jax.random.fold_in(round_key, FAILURE_STREAM_SALT)
 
 
 def round_rates(round_key: jax.Array, cfg: Dict[str, Any],

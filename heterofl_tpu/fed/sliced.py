@@ -30,7 +30,8 @@ import numpy as np
 from ..models import make_model
 from ..models.spec import count_masks as make_count_masks
 from ..parallel.round_engine import RoundEngine
-from .core import combine_counted, embed_sliced, extract_sliced
+from .core import (client_stream_keys, combine_counted, embed_sliced,
+                   extract_sliced)
 
 
 class SlicedFederation:
@@ -69,8 +70,9 @@ class SlicedFederation:
                     ):
         """One round. ``data`` is the same stacked tuple the masked engine
         takes (vision: ``x[U,N,...], y, m, lm``; LM: ``rows[U,R,T], lm``).
-        Client ``u`` uses PRNG key ``fold_in(key, 13 + u)`` (its global user
-        id), matching the masked engine on any mesh/placement."""
+        Client ``u`` uses the PRNG key ``client_stream_keys`` derives from
+        its global user id, matching the masked engine on any
+        mesh/placement."""
         gp_np = {k: np.asarray(v) for k, v in global_params.items()}
         shapes = {k: v.shape for k, v in gp_np.items()}
         summed = {k: np.zeros(s, np.float32) for k, s in shapes.items()}
@@ -94,7 +96,7 @@ class SlicedFederation:
             params_stack = {k: jnp.asarray(np.broadcast_to(
                 v, (len(slots),) + v.shape)) for k, v in sliced.items()}
             u = user_idx[slots]
-            keys = jnp.stack([jax.random.fold_in(key, 13 + int(ui)) for ui in u])
+            keys = client_stream_keys(key, np.asarray(u))
             client_data = tuple(jnp.asarray(np.asarray(a)[u]) for a in data)
             trained, ms = self._level_fn(rate)(params_stack, *client_data, keys,
                                                jnp.asarray(lr, jnp.float32))

@@ -107,9 +107,10 @@ def resolve_arms_cfg(cfg: Dict[str, Any]) -> Optional[ArmsSpec]:
                  "seeds": [None, 7, 11, 13],     # optional
                  "lr_scales": [1.0, 0.3, 3.0, 1.0]}  # optional
 
-    Cross-field conflicts (strategy/codec/schedule/store) live in the
-    engines and the drivers, which own those facts -- same split as
-    ``resolve_telemetry_cfg``."""
+    Cross-field conflicts (strategy/codec/schedule/store) ALSO refuse
+    here (ISSUE 18: one validator per axis is the lattice's source of
+    truth); the engines and drivers keep their checks as
+    defense-in-depth for direct construction."""
     raw = cfg.get("arms")
     if raw is None:
         return None
@@ -159,4 +160,68 @@ def resolve_arms_cfg(cfg: Dict[str, Any]) -> Optional[ArmsSpec]:
                     or not s > 0.0:
                 raise ValueError(f"Not valid arm lr_scale: {s!r} (a "
                                  f"positive number)")
+    # arms x everything cross-checks (ISSUE 18): promoted from the driver
+    # and the engine constructors so an un-batchable arms config refuses
+    # at config resolution.  This validator OWNS the arms axis in the
+    # staticcheck lattice; each refusal below names the ROADMAP follow-on
+    # that would lift it.
+    strategy = cfg.get("strategy", "masked") or "masked"
+    if strategy == "sliced":
+        raise ValueError(
+            "Not valid arms with strategy='sliced': the sliced debug twin "
+            "replays the reference host loop one trajectory at a time -- "
+            "use a mesh-native strategy ('masked' or 'grouped')")
+    if (cfg.get("ledger", "off") or "off") == "on":
+        raise ValueError(
+            "Not valid arms with ledger='on': the O(active) fold consumes "
+            "ONE sampling stream's cohort rows, and each arm draws its own "
+            "(a ROADMAP follow-on)")
+    if cfg.get("trace_dir"):
+        raise ValueError(
+            "Not valid arms with trace_dir: the multiplexed loop does not "
+            "build the TraceRecorder, so the trace would be silently empty "
+            "(a ROADMAP follow-on; per-arm probes/watchdog DO run)")
+    if ((cfg.get("schedule") or {}).get("aggregation") or "sync") \
+            == "buffered":
+        raise ValueError(
+            "Not valid arms with schedule aggregation='buffered': the "
+            "staleness buffer is a replicated carry with its own "
+            "donation/checkpoint contract -- batch dense-sync arms or run "
+            "buffered solo")
+    if (cfg.get("client_store", "eager") or "eager") == "stream":
+        raise ValueError(
+            "Not valid arms with client_store='stream': the streaming "
+            "cohort pipeline stages ONE schedule's shards per superstep "
+            "(a ROADMAP follow-on)")
+    if strategy == "grouped":
+        codec = cfg.get("wire_codec", "dense") or "dense"
+        if isinstance(codec, dict) and all(v == "dense"
+                                           for v in codec.values()):
+            codec = "dense"
+        if codec != "dense":
+            raise ValueError(
+                f"Not valid arms with wire_codec={codec!r} under strategy="
+                f"'grouped': the grouped EF-residual carry does not batch "
+                f"over the arms axis yet (a ROADMAP follow-on) -- grouped "
+                f"arms need the dense wire codec, or use the masked engine "
+                f"for codec arms")
+        if (cfg.get("telemetry", "off") or "off") != "off":
+            raise ValueError(
+                "Not valid arms with telemetry on under strategy="
+                "'grouped': the span probe rows do not carry the arms "
+                "axis yet (a ROADMAP follow-on); the masked engine "
+                "supports telemetry x arms")
+        if (cfg.get("quarantine", "off") or "off") != "off":
+            raise ValueError(
+                "Not valid arms with quarantine on under strategy="
+                "'grouped': the quarantine counter rides the probe rows, "
+                "which do not carry the arms axis yet (a ROADMAP "
+                "follow-on); the masked engine supports quarantine x arms")
+        if (cfg.get("level_placement", "span") or "span") == "slices":
+            raise ValueError(
+                "Not valid arms with level_placement='slices': the slices "
+                "layout dispatches each level to its own device rows, and "
+                "the arms axis would have to batch across disjoint "
+                "sub-meshes (a ROADMAP follow-on) -- arms need "
+                "level_placement='span'")
     return ArmsSpec(count, seeds, scales)

@@ -140,8 +140,8 @@ def resolve_quarantine_cfg(cfg: Dict[str, Any]) -> QuarantineSpec:
     if raw is None or raw == "off":
         return QuarantineSpec()
     if raw == "on":
-        return QuarantineSpec(enabled=True)
-    if isinstance(raw, dict):
+        spec = QuarantineSpec(enabled=True)
+    elif isinstance(raw, dict):
         unknown = set(raw) - {"max_norm"}
         if unknown:
             raise ValueError(f"Not valid quarantine keys: {sorted(unknown)} "
@@ -152,10 +152,20 @@ def resolve_quarantine_cfg(cfg: Dict[str, Any]) -> QuarantineSpec:
             raise ValueError(f"Not valid quarantine max_norm: {mn!r} (a "
                              f"positive update-norm bound, or None for the "
                              f"finiteness-only gate)")
-        return QuarantineSpec(enabled=True,
+        spec = QuarantineSpec(enabled=True,
                               max_norm=None if mn is None else float(mn))
-    raise ValueError(f"Not valid quarantine: {raw!r} ('off', 'on' or a "
-                     f"{{'max_norm': R}} dict)")
+    else:
+        raise ValueError(f"Not valid quarantine: {raw!r} ('off', 'on' or a "
+                         f"{{'max_norm': R}} dict)")
+    # quarantine x engine cross-check (ISSUE 18): promoted from the driver.
+    # This validator OWNS the quarantine axis in the staticcheck lattice.
+    if (cfg.get("strategy", "masked") or "masked") == "sliced":
+        raise ValueError(
+            "Not valid quarantine with strategy='sliced': the gate lives "
+            "in the mesh-native engines' round cores ('masked' or "
+            "'grouped'); the sliced debug twin replays the reference host "
+            "loop and has no in-program round core to gate")
+    return spec
 
 
 class TelemetrySpec:
@@ -186,12 +196,27 @@ def resolve_ledger_cfg(cfg: Dict[str, Any]) -> LedgerSpec:
 
     THE one validator (the PR 6/8/9 convention): an unknown mode fails
     loudly at config time, never as a silent ledger-off fallback mid-run.
-    Cross-field constraints (strategy/placement) live in the driver, which
-    owns those facts."""
+    The strategy/placement cross-checks are promoted from the driver
+    (ISSUE 18) -- this validator OWNS the ledger axis in the staticcheck
+    lattice."""
     mode = cfg.get("ledger", "off") or "off"
     if mode not in LEDGER_MODES:
         raise ValueError(f"Not valid ledger: {mode!r} "
                          f"(one of {LEDGER_MODES})")
+    if mode == "on":
+        if (cfg.get("strategy", "masked") or "masked") == "sliced":
+            raise ValueError(
+                "Not valid ledger='on' with strategy='sliced': the sliced "
+                "debug twin replays the reference host loop, whose metrics "
+                "never ride the fetch path the ledger folds from -- use a "
+                "mesh-native strategy ('masked' or 'grouped')")
+        if cfg.get("data_placement") == "sharded":
+            raise ValueError(
+                "Not valid ledger='on' with data_placement='sharded': the "
+                "sharded slot packing re-orders metric rows by owning "
+                "device, dropping the schedule-order uid alignment the "
+                "O(active) fold consumes -- use replicated (or streaming) "
+                "placement")
     return LedgerSpec(enabled=mode == "on")
 
 
@@ -263,6 +288,27 @@ def resolve_telemetry_cfg(cfg: Dict[str, Any]) -> TelemetrySpec:
     if trace_dir is not None and not isinstance(trace_dir, str):
         raise ValueError(f"Not valid trace_dir: {trace_dir!r} (a directory "
                          f"path for trace.json + events.jsonl, or None)")
+    # telemetry x engine cross-checks (ISSUE 18): promoted from the driver
+    # so an unprobeable telemetry config refuses at config resolution.
+    # This validator OWNS the telemetry axis in the staticcheck lattice.
+    if mode != "off":
+        strategy = cfg.get("strategy", "masked") or "masked"
+        if strategy == "sliced":
+            raise ValueError(
+                f"Not valid telemetry={mode!r} with strategy='sliced': the "
+                f"sliced debug twin replays the reference host loop and "
+                f"has no in-program round core to probe -- use a "
+                f"mesh-native strategy ('masked' or 'grouped')")
+        if strategy == "grouped" \
+                and int(cfg.get("superstep_rounds", 1) or 1) <= 1 \
+                and (cfg.get("client_store", "eager") or "eager") != "stream":
+            raise ValueError(
+                f"Not valid telemetry={mode!r} with strategy='grouped' at "
+                f"superstep_rounds<=1 and client_store='eager': the K=1 "
+                f"path splits the round across L+1 host-orchestrated "
+                f"programs with no shared round core to probe -- telemetry "
+                f"needs the fused superstep path (superstep_rounds>1) or "
+                f"client_store='stream'")
     return TelemetrySpec(probes=mode != "off", watchdog=watchdog,
                          trace_dir=trace_dir, hist=mode == "hist")
 

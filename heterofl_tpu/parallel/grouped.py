@@ -43,8 +43,9 @@ device ranges; per-round count fluctuation is absorbed by slot bucketing
 inside each slice.  Multi-process meshes fall back to ``span`` (slice
 boundaries are not yet host-aligned).
 
-Client PRNG keys are ``fold_in(key, 13 + global_uid)`` -- the masked
-engine's convention -- so with the same inputs both engines produce the same
+Client PRNG keys are ``fold_in(fold_in(key, CLIENT_STREAM_SALT),
+global_uid)`` (:func:`~..fed.core.client_stream_keys`, the masked
+engine's convention) -- so with the same inputs both engines produce the same
 new global parameters (tests/test_grouped.py) up to float association.
 
 Trade-off vs masked: dense per-level compute wins when active-clients /
@@ -64,8 +65,9 @@ from jax.sharding import PartitionSpec as P
 
 from ..compress import make_codec, resid_slots, resolve_codec_cfg
 from ..config import resolve_prefetch_depth
-from ..fed.core import (arm_stream_keys, combine_counted, embed_sliced_jnp,
-                        extract_sliced_jnp, level_flop_table, snap_to_levels)
+from ..fed.core import (arm_stream_keys, client_stream_keys, combine_counted,
+                        embed_sliced_jnp, extract_sliced_jnp,
+                        failure_stream_key, level_flop_table, snap_to_levels)
 from ..fed.sampling import resolve_sampler_cfg
 from ..models import make_model
 from ..multi import resolve_arms_cfg
@@ -138,7 +140,8 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         # wire codec (ISSUE 8): compression lives in the fused superstep
         # (where the ONE global psum is); the K=1 host-orchestrated
         # per-level path stays dense and train_round refuses lossy codecs
-        self._codec_name, self._error_feedback = resolve_codec_cfg(cfg)
+        self._codec_name, self._error_feedback = resolve_codec_cfg(
+            cfg, engine_strategy="grouped")
         self._codec_obj = None
         self._resid = None
         # per-level codec selection (ISSUE 9 satellite): a {rate: codec}
@@ -475,14 +478,14 @@ class GroupedRoundEngine(_WireCodecCarry, _SchedBufCarry):
         ugid = jnp.maximum(uarr, 0)
         if self.failure_rate > 0.0:
             # same crash model + PRNG stream as the masked engine
-            fkey = jax.random.fold_in(key, 98)
+            fkey = failure_stream_key(key)
             alive = 1.0 - jax.vmap(
                 lambda u: jax.random.bernoulli(
                     jax.random.fold_in(fkey, u), self.failure_rate)
             )(ugid).astype(jnp.float32)
             valid = valid * alive
         sub = extract_sliced_jnp(params, gm.specs, gm.groups, wr)
-        slot_keys = jax.vmap(lambda u: jax.random.fold_in(key, 13 + u))(ugid)
+        slot_keys = client_stream_keys(key, ugid)
         lm = lm_all if local_data else lm_all[ugid]
         if self.is_lm:
             rows = data[0] if local_data else data[0][ugid]

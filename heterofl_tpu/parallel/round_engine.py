@@ -43,8 +43,8 @@ from ..obs import resolve_quarantine_cfg, resolve_telemetry_cfg, split_probes
 from ..obs.hist import round_hists
 from ..obs.probes import round_probes
 from ..data.datasets import DATASET_STATS
-from ..fed.core import (arm_stream_keys, combine_counted, round_rates,
-                        round_users)
+from ..fed.core import (arm_stream_keys, client_stream_keys, combine_counted,
+                        failure_stream_key, round_rates, round_users)
 from ..fed.sampling import resolve_sampler_cfg
 from ..sched import resolve_schedule_cfg
 from ..sched.buffer import _SchedBufCarry, buffered_combine
@@ -432,7 +432,8 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         # round program -- quantise -> ONE global psum -> dequantise, with
         # the error-feedback residual as an extra donated carry.  'dense'
         # keeps today's program bit for bit (no new args, no residual).
-        self._codec_name, self._error_feedback = resolve_codec_cfg(cfg)
+        self._codec_name, self._error_feedback = resolve_codec_cfg(
+            cfg, engine_strategy="masked")
         if isinstance(self._codec_name, dict):
             # per-level maps belong to the grouped engine's fused superstep;
             # this engine may still be CONSTRUCTED (the driver always builds
@@ -857,7 +858,7 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
             # implicitly via frac-sampling): a failed client trains but
             # its update never reaches aggregation -- like a crash after
             # local work. All-failed rounds degrade to the stale rule.
-            fkey = jax.random.fold_in(key, 98)
+            fkey = failure_stream_key(key)
             alive = 1.0 - jax.vmap(
                 lambda u: jax.random.bernoulli(jax.random.fold_in(fkey, u), failure_rate)
             )(ugid).astype(jnp.float32)
@@ -870,7 +871,7 @@ class RoundEngine(_WireCodecCarry, _SchedBufCarry):
         else:
             rates_abs = data[-1][ugid]  # fix_rates passed as last data arg
         wr = rates_abs / self.global_rate
-        slot_keys = jax.vmap(lambda u: jax.random.fold_in(key, 13 + u))(ugid)
+        slot_keys = client_stream_keys(key, ugid)
 
         if self.is_lm:
             all_rows, all_lm = data[0], data[1]

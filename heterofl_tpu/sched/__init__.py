@@ -252,6 +252,37 @@ def resolve_schedule_cfg(cfg: Dict[str, Any]) -> ScheduleSpec:
         raise ValueError(f"Not valid schedule staleness: {staleness!r} "
                          f"(the buffered combine's mixing coefficient, in "
                          f"(0, 1])")
-    return ScheduleSpec(kind=kind, trace=trace, markov=markov,
+    spec = ScheduleSpec(kind=kind, trace=trace, markov=markov,
                         deadline_min_frac=deadline_min_frac,
                         aggregation=agg, staleness=float(staleness))
+    # scheduler x engine/codec cross-checks (ISSUE 18): promoted from the
+    # driver so a scenario the engines cannot lower refuses at config
+    # resolution.  This validator OWNS the scheduler axis in the
+    # staticcheck config lattice.
+    strategy = cfg.get("strategy", "masked") or "masked"
+    if not spec.lockstep and strategy == "sliced":
+        raise ValueError(
+            "Not valid schedule with strategy='sliced': scenarios "
+            "(trace/markov availability, deadline, buffered aggregation) "
+            "need a mesh-native strategy ('masked' or 'grouped'); the "
+            "sliced debug twin replays the reference host loop")
+    if spec.buffered:
+        codec = cfg.get("wire_codec", "dense") or "dense"
+        if isinstance(codec, dict) and all(v == "dense"
+                                           for v in codec.values()):
+            codec = "dense"  # an all-dense map collapses to the plain path
+        if codec != "dense":
+            raise ValueError(
+                f"Not valid schedule aggregation='buffered' with "
+                f"wire_codec={codec!r}: both add a scan carry with its "
+                f"own donation/checkpoint contract -- pick one per "
+                f"experiment")
+        if strategy == "grouped" \
+                and int(cfg.get("superstep_rounds", 1) or 1) <= 1 \
+                and (cfg.get("client_store", "eager") or "eager") != "stream":
+            raise ValueError(
+                "Not valid schedule aggregation='buffered' with strategy="
+                "'grouped' at superstep_rounds<=1 and client_store="
+                "'eager': the K=1 host-orchestrated path combines in its "
+                "own program and has no scan carry to buffer")
+    return spec

@@ -50,6 +50,19 @@ def main(argv=None) -> int:
                              "to 2%%)")
     parser.add_argument("--skip-audit", action="store_true",
                         help="lint only (never imports jax)")
+    parser.add_argument("--list", action="store_true",
+                        help="print the program/check matrix (names only, "
+                             "nothing is audited) and exit")
+    parser.add_argument("--only", metavar="GLOB", default=None,
+                        help="audit only programs matching this fnmatch "
+                             "glob; cross-program checks (flop budget, "
+                             "lattice, key streams, ...) are skipped -- "
+                             "incompatible with --diff-baseline/"
+                             "--update-baseline")
+    parser.add_argument("--lattice-md", action="store_true",
+                        help="print the compatibility-lattice markdown "
+                             "(the README section is generated from this; "
+                             "jax-free) and exit")
     parser.add_argument("--aot-v4128", action="store_true",
                         help="also run the subprocess v4-128 AOT multi-"
                              "host check (ISSUE 17); records into "
@@ -84,14 +97,46 @@ def main(argv=None) -> int:
     if (args.diff_baseline or args.update_baseline) and args.skip_audit:
         parser.error("--diff-baseline/--update-baseline need the program "
                      "audit (drop --skip-audit)")
+    if args.only and (args.diff_baseline or args.update_baseline):
+        parser.error("--only audits a subset -- the ratchet baseline "
+                     "covers the full matrix (drop --only)")
+
+    if args.lattice_md:
+        # jax-free: the lattice replays the validator chain, nothing is
+        # traced.  ``--lattice-md > section.md`` regenerates the README's
+        # "Compatibility lattice" section.
+        from .lattice import lattice_markdown
+
+        print(lattice_markdown())
+        return 0
+
+    if args.list:
+        _scrub_env_for_cpu_audit()
+        from .audit import CROSS_CHECKS, list_targets
+
+        names = list_targets(flagship=args.flagship, seed=args.seed)
+        print(f"# {len(names)} programs (audit matrix)")
+        for n in names:
+            print(f"program {n}")
+        print(f"# {len(CROSS_CHECKS)} cross-program checks "
+              f"(skipped under --only)")
+        for c in CROSS_CHECKS:
+            print(f"check   {c}")
+        print("check   lint")
+        return 0
 
     from .report import AuditReport
-    from .rules import lint_tree
+    from .rules import lint_tree, pragma_sweep
 
     lint_findings = []
     if not args.skip_lint:
         subdirs = ["heterofl_tpu"] if args.lint_root == _REPO else None
         lint_findings = lint_tree(args.lint_root, subdirs=subdirs)
+        if subdirs:
+            # ISSUE 18 satellite: pragma liveness sweeps the WHOLE repo
+            # (tests/, scripts/, ...), not just the scoped package tree
+            lint_findings += pragma_sweep(args.lint_root,
+                                          exclude=tuple(subdirs))
 
     if args.skip_audit:
         report = AuditReport()
@@ -103,7 +148,8 @@ def main(argv=None) -> int:
         from .audit import run_audit
 
         report = run_audit(flagship=args.flagship, flop_tol=args.flop_tol,
-                           seed=args.seed, with_aot=args.aot_v4128)
+                           seed=args.seed, with_aot=args.aot_v4128,
+                           only=args.only)
     report.add_lint(lint_findings)
     report.generated_at = datetime.now(timezone.utc).isoformat()
     report.config["argv"] = list(argv) if argv is not None else sys.argv[1:]

@@ -71,16 +71,18 @@ monotonicity check carries the regression-catching weight instead.
 
 from __future__ import annotations
 
+import fnmatch
 import math
+import os
 import warnings
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 from .jaxpr_walk import (aliased_outputs, count_collectives, count_psum_joint,
                          count_psum_over, donation_marks, find_callbacks,
-                         find_f64, find_reshards, reshard_ops,
-                         scan_body_kernel_count)
+                         find_f64, find_reshards, random_bind_files,
+                         reshard_ops, scan_body_kernel_count)
 from .memory import (analytic_budget, check_memory, collect_memory,
                      donation_accounting)
 from .report import AuditReport, Finding, ProgramReport
@@ -784,7 +786,11 @@ def _sched_targets(setup) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
     # the single bind's payload equals the per-level byte-table sum
     level_rates = sorted(bt, reverse=True)
     codec_map = {r: ("int8" if r == top else "dense") for r in level_rates}
-    mcfg = dict(cfg, wire_codec={f"{r:g}": c for r, c in codec_map.items()})
+    # the per-level map is a grouped-superstep-only feature, and
+    # resolve_codec_cfg (which the engine ctor re-applies) refuses it
+    # elsewhere -- declare the strategy/K this target actually audits
+    mcfg = dict(cfg, strategy="grouped", superstep_rounds=k,
+                wire_codec={f"{r:g}": c for r, c in codec_map.items()})
     grp_pl = GroupedRoundEngine(mcfg, mesh)
     grp_pl._lr_fn = make_traced_lr_fn(cfg)
     lay = grp_pl._map_layout(params)
@@ -1235,14 +1241,22 @@ def codec_frontier_check(report: "AuditReport") -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 def audit_program(name: str, prog, args: Tuple, expect: Dict[str, Any],
-                  mesh) -> ProgramReport:
+                  mesh, bind_files: Optional[Set[str]] = None) -> ProgramReport:
     """Trace, lower and compile one program; run checks (a)-(c), the ISSUE 7
     wire/HBM/reshard passes, and record flops/memory for (e).  Never
-    executes the program."""
+    executes the program.
+
+    ``bind_files`` (ISSUE 18): a shared set the caller passes to collect
+    the package-relative source files of every PRNG bind in the traced
+    jaxpr -- the key-stream audit cross-checks them against its modeled
+    modules."""
     from ..analysis import cost_analysis_dict
 
     rep = ProgramReport(name=name, donation_expected=int(expect["donated"]))
     jaxpr = prog.trace(*args).jaxpr
+    if bind_files is not None:
+        bind_files.update(random_bind_files(
+            jaxpr, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
     for prim, prov in find_callbacks(jaxpr):
         rep.fail("no-host-callback",
                  f"host callback op `{prim}` inside the round program "
@@ -1671,9 +1685,40 @@ def flop_budget_check(report: AuditReport, setup,
 # entry points
 # ---------------------------------------------------------------------------
 
+def _build_targets(setup):
+    """Assemble the full program matrix: ``(targets, level_prog_names)``
+    where each target is ``(name, prog, args, expect)``.  Shared by the
+    audit proper and the CLI's ``--list``."""
+    targets = list(_masked_targets(setup))
+    grouped, level_prog_names, _ = _grouped_targets(setup)
+    targets.extend(grouped)
+    targets.extend(_codec_targets(setup))
+    targets.extend(_sched_targets(setup))
+    targets.extend(_obs_targets(setup))
+    targets.extend(_obs_hist_targets(setup))
+    targets.extend(_quarantine_targets(setup))
+    targets.extend(_arms_targets(setup))
+    return targets, level_prog_names
+
+
+#: names of the cross-program checks, for ``--list`` (the per-program
+#: checks run inside every audited program and have no standalone names)
+CROSS_CHECKS = ("flop_budget", "wire_frontier", "sampler", "arms",
+                "recompile", "lattice", "key_streams")
+
+
+def list_targets(flagship: bool = False, seed: int = 0) -> List[str]:
+    """Program names of the audit matrix, without auditing anything
+    (the target builders only close over setup; nothing is traced)."""
+    setup = build_setup(flagship=flagship, seed=seed)
+    targets, _ = _build_targets(setup)
+    return [name for name, _prog, _args, _expect in targets]
+
+
 def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
               seed: int = 0, with_recompile_check: bool = True,
-              with_aot: bool = False) -> AuditReport:
+              with_aot: bool = False,
+              only: Optional[str] = None) -> AuditReport:
     """The full program audit.  Returns an :class:`AuditReport` (the CLI
     adds lint findings and serialises to STATICCHECK.json).
 
@@ -1681,7 +1726,13 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
     check (ISSUE 17) and records it under ``config["aot_v4128"]`` -- a
     config record, never a program entry, so the ratchet baseline stays
     environment-stable; a child that RAN and violated the DCN budget
-    still fails the audit."""
+    still fails the audit.
+
+    ``only`` (ISSUE 18): an fnmatch glob over program names; audits the
+    matching subset and SKIPS every cross-program check (they reason
+    over the full matrix -- a partial run would fabricate findings).
+    The CLI refuses ``--only`` + ``--diff-baseline`` for the same
+    reason."""
     report = AuditReport()
     setup = build_setup(flagship=flagship, seed=seed)
     report.config = {
@@ -1695,23 +1746,46 @@ def run_audit(flagship: bool = False, flop_tol: Optional[float] = None,
                          (int(s) for s in setup["mesh"].devices.shape))),
     }
     mesh = setup["mesh"]
-    targets = list(_masked_targets(setup))
-    grouped, level_prog_names, _ = _grouped_targets(setup)
-    targets.extend(grouped)
-    targets.extend(_codec_targets(setup))
-    targets.extend(_sched_targets(setup))
-    targets.extend(_obs_targets(setup))
-    targets.extend(_obs_hist_targets(setup))
-    targets.extend(_quarantine_targets(setup))
-    targets.extend(_arms_targets(setup))
+    targets, level_prog_names = _build_targets(setup)
+    if only is not None:
+        report.config["only"] = only
+        targets = [t for t in targets if fnmatch.fnmatch(t[0], only)]
+    bind_files: Set[str] = set()
     for name, prog, args, expect in targets:
-        report.add_program(audit_program(name, prog, args, expect, mesh))
+        report.add_program(audit_program(name, prog, args, expect, mesh,
+                                         bind_files=bind_files))
+
+    if only is not None:
+        skipped = {"ok": True, "skipped": f"--only {only}"}
+        report.flop_budget = dict(skipped)
+        report.recompile = dict(skipped)
+        report.wire_frontier = dict(skipped)
+        report.sampler = dict(skipped)
+        report.arms = dict(skipped)
+        report.lattice = dict(skipped)
+        report.key_streams = dict(skipped)
+        return report
 
     report.flop_budget = flop_budget_check(report, setup, level_prog_names,
                                            tol=flop_tol)
     report.wire_frontier = codec_frontier_check(report)
     report.sampler = sampler_stream_check(report, setup)
     report.arms = arms_flop_check(report)
+
+    # ISSUE 18: config-lattice exhaustiveness + RNG-stream provenance.
+    # The lattice's program: evidence refs must point at GREEN audited
+    # programs; the key-stream pass gets the PRNG bind files collected
+    # from every traced jaxpr above.
+    from .keys import key_streams_check
+    from .lattice import lattice_check
+
+    report.lattice = lattice_check(
+        audited={n for n, p in report.programs.items() if p.ok})
+    report.ok = report.ok and report.lattice["ok"]
+    report.key_streams = key_streams_check(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        bind_files=sorted(bind_files))
+    report.ok = report.ok and report.key_streams["ok"]
     if with_recompile_check:
         rc = recompile_hazard_check(setup)
         for which, sizes in list(rc.items()):

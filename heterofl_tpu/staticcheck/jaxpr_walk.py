@@ -60,6 +60,36 @@ def primitive_counts(jaxpr) -> Counter:
     return Counter(eqn.primitive.name for eqn in iter_eqns(jaxpr))
 
 
+#: primitives that derive or consume PRNG state in a traced program --
+#: every one of these binds must descend from a declared (salt, purpose)
+#: root (ISSUE 18: staticcheck/keys.py)
+RANDOM_PRIMITIVE_PREFIXES = ("random_", "threefry")
+
+
+def random_bind_files(jaxpr, package_root: str) -> Set[str]:
+    """Package-relative source files of every PRNG bind in ``jaxpr``.
+
+    Walks all ``random_*``/``threefry*`` eqns (recursing into sub-jaxprs)
+    and maps each bind's user frame back to the file that bound it; files
+    outside ``package_root`` (jax internals, test harnesses) are dropped.
+    The key-stream audit cross-checks the result against the modules its
+    SALT_REGISTRY models -- randomness appearing in an unmodeled package
+    file has no declared provenance."""
+    import os
+
+    root = os.path.abspath(package_root)
+    files: Set[str] = set()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if not any(name.startswith(p) for p in RANDOM_PRIMITIVE_PREFIXES):
+            continue
+        prov = provenance(eqn)  # "path:line (fn)"
+        path = os.path.abspath(prov.rsplit(":", 1)[0])
+        if path.startswith(root + os.sep):
+            files.add(os.path.relpath(path, root).replace(os.sep, "/"))
+    return files
+
+
 def find_callbacks(jaxpr) -> List[Tuple[str, str]]:
     """(primitive name, provenance) of every host-callback op."""
     out = []

@@ -1,0 +1,581 @@
+"""Key-stream audit (ISSUE 18): the PRNG salt/fold_in provenance graph.
+
+The repo derives every random stream by ``jax.random.fold_in`` from a
+small set of named roots (the driver host key, the per-epoch/round keys,
+the per-client slot keys, ...).  Correctness of the engine-equivalence
+contracts and of the fault-tolerance replay story rests on those streams
+being DISJOINT: two different purposes must never fold the same salt
+into the same root, or their "independent" draws are bit-identical
+copies of one another.  That property is invisible at runtime -- a
+collision produces valid-looking numbers -- so this module proves it
+statically:
+
+* ``SALT_REGISTRY`` declares every ``fold_in`` site in the package as a
+  ``(root, stream)`` edge of the provenance graph.  The AST scanner
+  walks the real tree; a fold site the registry does not recognise is a
+  ``key-undeclared-stream`` finding, a registry row matching no site is
+  ``key-registry-stale`` (the declaration rotted).
+* ``ROOTS`` declares, per root, the integer interval each stream's salts
+  occupy.  Overlapping intervals under one root are ``key-salt-collision``
+  findings.  This is the check that catches the two real collisions the
+  audit was built on: the flat ``fold_in(round_key, 13 + uid)`` client
+  derivation whose uid family swallowed the failure salt 98 and the
+  deadline salt 131, and ``ARM_STREAM_SALT = 17`` sitting inside the
+  host key's per-round epoch family (round 17's key WAS the arms root).
+* ``SALT_CONSTANTS`` pins every module-level ``*_SALT`` constant by
+  value; drift (changed, added or deleted constants) is
+  ``key-salt-drift`` -- a salt cannot move without this table moving
+  with it, which forces the interval review above.
+* A per-function scan flags a raw key consumed by two or more
+  ``jax.random`` draws (``key-raw-reuse``): reusing an unsplit key makes
+  the two draws correlated.
+* ``check_binds`` receives, from the compiled-program audit, the source
+  files of every in-jaxpr ``random_*`` bind; a bind originating from a
+  package file the registry does not model is ``key-unrooted-bind`` --
+  randomness with no declared (salt, purpose) ancestry.
+
+Everything here is stdlib-only (ast + re): the pass must run where jax
+is absent, and the registry doubles as the human-readable inventory of
+every random stream in the system.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: Findings lists inside the report section are capped (the section is
+#: evidence, not an enumeration) -- same cap as the lattice pass.
+MAX_FINDING_SAMPLES = 12
+
+#: Modeled bounds of the symbolic fold families.  These are the audit's
+#: declared envelopes, deliberately generous: epochs/rounds are 1-based
+#: and bounded by NUM_ROUNDS_BOUND, per-client uids by NUM_USERS_BOUND,
+#: watchdog retries by MAX_RETRIES_BOUND, arm sweep seeds by MAX_ARMS.
+NUM_ROUNDS_BOUND = 4096
+NUM_USERS_BOUND = 4096
+MAX_RETRIES_BOUND = 32
+MAX_ARMS_BOUND = 64
+
+#: Module-level ``*_SALT`` constants, pinned by value.  The scanner
+#: diffs the real tree against this table; any drift is a finding, so a
+#: salt cannot change silently -- changing one forces a review of the
+#: interval declarations below.
+SALT_CONSTANTS: Dict[str, Dict[str, int]] = {
+    "compress/codecs.py": {"QUANT_NOISE_SALT": 9173, "TOPK_BLOCK_SALT": 9177},
+    "fed/core.py": {
+        "ROUND_RATE_SALT": 7,
+        "USER_SAMPLE_SALT": 11,
+        "CLIENT_STREAM_SALT": 13,
+        "FAILURE_STREAM_SALT": 98,
+        "ARM_STREAM_SALT": 0x4152,
+    },
+    "fed/sampling.py": {"PRP_KEY_SALT": 23},
+    "obs/watchdog.py": {"RETRY_SALT": 0x5EED},
+    "sched/deadline.py": {"DEADLINE_SALT": 131},
+}
+
+#: Per-root stream intervals ``(stream, lo, hi)`` with ``hi`` exclusive;
+#: ``(stream, None, None)`` declares a symbolic single-family stream
+#: (e.g. a dropout site id) that is exempt from the interval check
+#: because it is the only family folded into that root at that layer.
+#: Within one root every bounded interval must be disjoint from every
+#: other -- that IS the no-collision proof.
+ROOTS: Dict[str, Tuple[Tuple[str, Optional[int], Optional[int]], ...]] = {
+    # The driver's host key: params init (0), the per-round epoch keys
+    # (1-based, one per round), the arms salt root, the watchdog's
+    # replayed-retry window.  ARM_STREAM_SALT's old value 17 overlapped
+    # the epoch family here.
+    "host_key": (
+        ("init", 0, 1),
+        ("epoch", 1, 1 + NUM_ROUNDS_BOUND),
+        ("arms", 0x4152, 0x4152 + 1),
+        ("retry", 0x5EED, 0x5EED + MAX_RETRIES_BOUND),
+    ),
+    # The per-round key handed to the engines: every subsystem folds its
+    # own named salt before drawing.  The client/failure streams moved
+    # to sub-roots (13/98) exactly so the unbounded uid family below
+    # cannot creep into this namespace.
+    "round_key": (
+        ("rate", 7, 8),
+        ("user-sample", 11, 12),
+        ("client-stream", 13, 14),
+        ("failure", 98, 99),
+        ("deadline", 131, 132),
+        ("quant-noise", 9173, 9174),
+        ("topk-block", 9177, 9178),
+    ),
+    # The per-client slot key inside local training: epoch-shuffle root,
+    # per-step augmentation keys, per-step model-apply rng.
+    "client_key": (
+        ("epoch-perm", 1, 2),
+        ("augment", 2, 2 + NUM_ROUNDS_BOUND),
+        ("model-rng", 5000, 5000 + NUM_ROUNDS_BOUND),
+    ),
+    # fold_in(round_key, CLIENT_STREAM_SALT) -> per-uid slot keys.
+    "client_stream_root": (("uid", 0, NUM_USERS_BOUND),),
+    # fold_in(round_key, FAILURE_STREAM_SALT) -> per-uid crash draws.
+    "failure_root": (("uid", 0, NUM_USERS_BOUND),),
+    # fold_in(round_key, DEADLINE_SALT) -> per-uid step budgets.
+    "deadline_root": (("uid", 0, NUM_USERS_BOUND),),
+    # fold_in(host_key, ARM_STREAM_SALT) -> per-arm streams by seed.
+    "arm_salt_key": (("seed", 0, MAX_ARMS_BOUND),),
+    # A per-arm root's params-init fold (the arms driver's twin of the
+    # host key's init stream).
+    "arm_root": (("init", 0, 1),),
+    # The per-epoch key: in-superstep round index t (0-based).
+    "epoch_key": (("round", 0, NUM_ROUNDS_BOUND),),
+    # Per-arm per-epoch key, same in-superstep round family.
+    "arm_epoch_key": (("round", 0, NUM_ROUNDS_BOUND),),
+    # The PRP sampler's commitment key.
+    "sample_key": (("prp", 23, 24),),
+    # Central (non-federated) baseline: per-global-step keys, then the
+    # step key's augment/model split.
+    "central_round_key": (("step", None, None),),
+    "central_step_key": (("augment", 1, 2), ("model-rng", 2, 3)),
+    # Evaluation: the users/global cohort roots, their per-epoch keys,
+    # and the per-slot decorrelation inside the sharded eval program.
+    "eval_base": (("users", 0, 1), ("global", 1, 2)),
+    "eval_users_root": (("epoch", 1, 1 + NUM_ROUNDS_BOUND),),
+    "eval_global_root": (("epoch", 1, 1 + NUM_ROUNDS_BOUND),),
+    "eval_epoch_key": (("slot", None, None),),
+    # Codecs: the salted codec key's per-device axis_index fold.
+    "codec_salted_key": (("device", None, None),),
+    # Model internals: rng -> corruption (0) / dropout base (1); the
+    # dropout base then folds the shard offset and per-site ids -- the
+    # site family is the only one at its layer (offset re-roots the
+    # base, see models/transformer.py).
+    "model_rng": (("corruption", 0, 1), ("dropout-base", 1, 2)),
+    "dropout_base": (("shard-offset", None, None), ("site", None, None)),
+    # Long-context LM data pipeline: per-document keys.
+    "lm_doc_key": (("doc", None, None),),
+    # Per-device augmentation decorrelation under data sharding.
+    "aug_shard_key": (("device", None, None),),
+    # The reference-twin comparison harness re-derives per-round keys
+    # from the bare seed (host-side, analysis only).
+    "reference_key": (("round", None, None),),
+    # Staticcheck's own audit probes (synthetic keys inside traced
+    # probe programs; not part of the training derivation tree).
+    "audit_probe": (("wire", 77, 78), ("arm", 1, 1 + MAX_ARMS_BOUND)),
+}
+
+#: THE declaration of every ``fold_in`` site in the package:
+#: ``(root, stream, module, key_regex, salt_regex, purpose)``.  The
+#: scanner fullmatches the unparsed key/salt expressions of each real
+#: call against these rows; ``(root, stream)`` must exist in ``ROOTS``.
+SALT_REGISTRY: Tuple[Tuple[str, str, str, str, str, str], ...] = (
+    ("reference_key", "round", "analysis/compare_reference.py",
+     r"jax\.random\.key\(seed\)", r"r",
+     "reference-twin per-round key from the bare seed"),
+    ("host_key", "retry", "chaos/drill.py",
+     r"key", r"RETRY_SALT \+ n",
+     "chaos drill replays the watchdog's retry keys"),
+    ("round_key", "quant-noise", "compress/codecs.py",
+     r"key", r"salt",
+     "codec noise root (QUANT_NOISE_SALT passed by value)"),
+    ("codec_salted_key", "device", "compress/codecs.py",
+     r"k", r"jax\.lax\.axis_index\(self\.axis\)",
+     "per-device codec noise decorrelation"),
+    ("round_key", "topk-block", "compress/codecs.py",
+     r"key", r"TOPK_BLOCK_SALT",
+     "top-k block permutation root"),
+    ("host_key", "init", "entry/central.py",
+     r"self\.host_key", r"0", "central params-init key"),
+    ("host_key", "epoch", "entry/central.py",
+     r"self\.host_key", r"epoch", "central per-epoch key"),
+    ("central_round_key", "step", "entry/central.py",
+     r"key", r"t", "central per-global-step key"),
+    ("central_step_key", "augment", "entry/central.py",
+     r"kk", r"1", "central augmentation key"),
+    ("central_step_key", "model-rng", "entry/central.py",
+     r"kk", r"2", "central model-apply rng"),
+    ("host_key", "epoch", "entry/common.py",
+     r"self\.host_key", r"epoch", "driver per-epoch key"),
+    ("host_key", "retry", "entry/common.py",
+     r"self\.host_key", r"RETRY_SALT \+ attempt",
+     "watchdog rollback retry keys"),
+    ("host_key", "init", "entry/common.py",
+     r"self\.host_key", r"0", "driver params-init key"),
+    ("arm_root", "init", "entry/common.py",
+     r"roots\[e\]", r"0", "per-arm params-init key"),
+    ("host_key", "arms", "fed/core.py",
+     r"base_key", r"ARM_STREAM_SALT", "arms salt root"),
+    ("arm_salt_key", "seed", "fed/core.py",
+     r"salted", r"s", "per-arm stream by sweep seed"),
+    ("round_key", "client-stream", "fed/core.py",
+     r"round_key", r"CLIENT_STREAM_SALT", "client-stream sub-root"),
+    ("client_stream_root", "uid", "fed/core.py",
+     r"root", r"u", "per-client slot key"),
+    ("round_key", "failure", "fed/core.py",
+     r"round_key", r"FAILURE_STREAM_SALT", "failure-draw sub-root"),
+    ("round_key", "rate", "fed/core.py",
+     r"round_key", r"ROUND_RATE_SALT", "dynamic width-rate draw"),
+    ("round_key", "user-sample", "fed/core.py",
+     r"round_key", r"USER_SAMPLE_SALT", "cohort sampling draw"),
+    ("host_key", "epoch", "fed/core.py",
+     r"host_key", r"epoch0 \+ r", "superstep per-round host keys"),
+    ("sample_key", "prp", "fed/sampling.py",
+     r"key", r"PRP_KEY_SALT", "PRP sampler commitment key"),
+    ("model_rng", "corruption", "models/transformer.py",
+     r"rng", r"0", "LM corruption draw root"),
+    ("model_rng", "dropout-base", "models/transformer.py",
+     r"rng", r"1", "dropout base key"),
+    ("dropout_base", "shard-offset", "models/transformer.py",
+     r"drop_base", r"off", "sequence-shard dropout decorrelation"),
+    ("dropout_base", "site", "models/transformer.py",
+     r"drop_base", r"site", "per-site dropout keys (remat-stable)"),
+    ("eval_base", "users", "parallel/evaluation.py",
+     r"base", r"0", "users-eval cohort root"),
+    ("eval_base", "global", "parallel/evaluation.py",
+     r"base", r"1", "global-eval cohort root"),
+    ("eval_users_root", "epoch", "parallel/evaluation.py",
+     r"self\._users_key|ukey_root", r"epoch", "users-eval per-epoch key"),
+    ("eval_global_root", "epoch", "parallel/evaluation.py",
+     r"self\._global_key|gkey_root", r"epoch", "global-eval per-epoch key"),
+    ("eval_epoch_key", "slot", "parallel/evaluation.py",
+     r"key", r"dev \* a \+ i", "per-slot eval decorrelation"),
+    ("epoch_key", "round", "parallel/grouped.py",
+     r"base_key", r"t", "grouped superstep per-round key"),
+    ("arm_epoch_key", "round", "parallel/grouped.py",
+     r"akey", r"t", "grouped arms per-round key"),
+    ("failure_root", "uid", "parallel/grouped.py",
+     r"fkey", r"u", "grouped per-client crash draw"),
+    ("lm_doc_key", "doc", "parallel/long_context.py",
+     r"key", r"idx", "long-context per-document key"),
+    ("host_key", "epoch", "parallel/pod.py",
+     r"host_key", r"epoch0 \+ r", "pod superstep per-round host keys"),
+    ("client_key", "epoch-perm", "parallel/round_engine.py",
+     r"key", r"1", "local-training epoch shuffle root"),
+    ("client_key", "augment", "parallel/round_engine.py",
+     r"key", r"2 \+ t", "per-step augmentation key"),
+    ("aug_shard_key", "device", "parallel/round_engine.py",
+     r"aug_key", r"d", "per-device augmentation decorrelation"),
+    ("client_key", "model-rng", "parallel/round_engine.py",
+     r"key", r"5000 \+ t", "per-step model-apply rng"),
+    ("failure_root", "uid", "parallel/round_engine.py",
+     r"fkey", r"u", "masked per-client crash draw"),
+    ("arm_epoch_key", "round", "parallel/round_engine.py",
+     r"akey", r"t", "masked arms per-round key"),
+    ("epoch_key", "round", "parallel/round_engine.py",
+     r"base_key", r"t", "masked superstep per-round key"),
+    ("round_key", "deadline", "sched/deadline.py",
+     r"key", r"DEADLINE_SALT", "deadline budget sub-root"),
+    ("deadline_root", "uid", "sched/deadline.py",
+     r"dkey", r"u", "per-client step-budget draw"),
+    ("audit_probe", "wire", "staticcheck/audit.py",
+     r"setup\['key'\]", r"77", "wire-frontier probe key"),
+    ("audit_probe", "arm", "staticcheck/audit.py",
+     r"base", r"1 \+ j", "arms probe per-arm keys"),
+)
+
+#: Modules whose in-jaxpr draws consume keys DERIVED in a modeled
+#: module (the fold_in provenance lives upstream; these only spend the
+#: key they were handed).  ``check_binds`` accepts binds traced to
+#: them; each entry documents which declared stream the key descends
+#: from so the acceptance is provenance, not a waiver.
+DERIVED_CONSUMER_MODULES: Dict[str, str] = {
+    "ops/quant.py": "codec_salted_key: stochastic-rounding draws on the "
+                    "key compress/codecs.py derives (QUANT_NOISE_SALT + "
+                    "per-device axis_index fold) and passes in",
+}
+
+#: ``jax.random`` draws that CONSUME a key (fold_in derives, these
+#: spend).  A bare key name fed to two of these in one function is a
+#: correlated-stream bug.
+CONSUMERS = frozenset({
+    "normal", "uniform", "bernoulli", "bits", "permutation",
+    "categorical", "gumbel", "laplace", "exponential", "randint",
+    "truncated_normal", "choice", "split",
+})
+
+
+# ---------------------------------------------------------------------------
+# scanners (pure ast, no jax)
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def scan_fold_sites(root_dir) -> List[Dict[str, Any]]:
+    """Every ``fold_in(key, salt)`` call under ``root_dir``, with the
+    key/salt argument expressions rendered back to source text."""
+    sites = []
+    for path in sorted(Path(root_dir).rglob("*.py")):
+        module = path.relative_to(root_dir).as_posix()
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call) and _call_name(node) == "fold_in"
+                    and len(node.args) >= 2):
+                sites.append({
+                    "module": module, "line": node.lineno,
+                    "key": ast.unparse(node.args[0]),
+                    "salt": ast.unparse(node.args[1]),
+                })
+    return sites
+
+
+def scan_salt_constants(root_dir) -> Dict[str, Dict[str, int]]:
+    """Module-level ``*_SALT = <int>`` assignments under ``root_dir``."""
+    found: Dict[str, Dict[str, int]] = {}
+    for path in sorted(Path(root_dir).rglob("*.py")):
+        module = path.relative_to(root_dir).as_posix()
+        tree = ast.parse(path.read_text())
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.endswith("_SALT")):
+                try:
+                    val = ast.literal_eval(node.value)
+                except ValueError:
+                    continue
+                if isinstance(val, int):
+                    found.setdefault(module, {})[node.targets[0].id] = val
+    return found
+
+
+def _exclusive(p1, p2) -> bool:
+    """Two branch paths are exclusive iff they take different arms of
+    the same ``if`` -- then at most one of the two sites executes."""
+    for a, b in zip(p1, p2):
+        if a[0] == b[0] and a[1] != b[1]:
+            return True
+        if a != b:
+            return False
+    return False
+
+
+def scan_raw_reuse(root_dir,
+                   consumers: frozenset = CONSUMERS) -> List[Dict[str, Any]]:
+    """Functions where one bare key name is consumed by >= 2 draws that
+    can execute together.
+
+    Branch-aware: two draws on opposite arms of the same ``if`` spend
+    the key once per execution path and are fine.  A name that is
+    (re)assigned anywhere inside the function is skipped: loop bodies
+    like ``key = fold_in(key, t); normal(key)`` rebind the name per
+    iteration, so textual repetition is not reuse there.  The flagged
+    shape -- a never-reassigned name spent twice on one path -- has no
+    such excuse: both draws read the identical key.  Nested function
+    defs are scanned as their own roots."""
+    findings = []
+    for path in sorted(Path(root_dir).rglob("*.py")):
+        module = path.relative_to(root_dir).as_posix()
+        tree = ast.parse(path.read_text())
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            assigned = set()
+            uses: Dict[str, List[Tuple[int, tuple]]] = {}
+
+            def walk(node, p):
+                if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and node is not fn):
+                    return
+                if isinstance(node, ast.If):
+                    walk(node.test, p)
+                    for n in node.body:
+                        walk(n, p + ((id(node), 0),))
+                    for n in node.orelse:
+                        walk(n, p + ((id(node), 1),))
+                    return
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                     ast.For, ast.NamedExpr, ast.comprehension)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                assigned.add(leaf.id)
+                if (isinstance(node, ast.Call) and _call_name(node) in consumers
+                        and node.args and isinstance(node.args[0], ast.Name)):
+                    uses.setdefault(node.args[0].id, []).append((node.lineno, p))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, p)
+
+            walk(fn, ())
+            for name, sites in sorted(uses.items()):
+                if name in assigned or len(sites) < 2:
+                    continue
+                clash = [
+                    (l1, l2)
+                    for i, (l1, p1) in enumerate(sites)
+                    for l2, p2 in sites[i + 1:] if not _exclusive(p1, p2)]
+                if clash:
+                    lines = sorted({ln for pair in clash for ln in pair})
+                    findings.append({
+                        "rule": "key-raw-reuse",
+                        "where": f"{module}:{lines[0]} {fn.name}()",
+                        "message": (
+                            f"raw key '{name}' consumed by multiple "
+                            f"jax.random draws on one path (lines "
+                            f"{lines}) without an intervening fold_in/"
+                            f"split -- the draws are correlated"),
+                    })
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# checks
+
+def _check_intervals(roots) -> List[Dict[str, Any]]:
+    findings = []
+    for root, streams in sorted(roots.items()):
+        bounded = [(s, lo, hi) for s, lo, hi in streams if lo is not None]
+        for i, (s1, lo1, hi1) in enumerate(bounded):
+            for s2, lo2, hi2 in bounded[i + 1:]:
+                if lo1 < hi2 and lo2 < hi1:
+                    findings.append({
+                        "rule": "key-salt-collision",
+                        "where": f"root {root}",
+                        "message": (
+                            f"streams '{s1}' [{lo1}, {hi1}) and '{s2}' "
+                            f"[{lo2}, {hi2}) overlap under root "
+                            f"'{root}': the same fold_in salt would "
+                            f"derive both purposes"),
+                    })
+    return findings
+
+
+def _check_constants(found, expected) -> List[Dict[str, Any]]:
+    findings = []
+    for module, consts in sorted(expected.items()):
+        have = found.get(module, {})
+        for name, val in sorted(consts.items()):
+            if name not in have:
+                findings.append({
+                    "rule": "key-salt-drift", "where": module,
+                    "message": f"declared salt {name}={val} no longer "
+                               f"defined in {module}",
+                })
+            elif have[name] != val:
+                findings.append({
+                    "rule": "key-salt-drift", "where": module,
+                    "message": (
+                        f"salt {name} drifted: declared {val}, found "
+                        f"{have[name]} -- update SALT_CONSTANTS and "
+                        f"re-review the ROOTS intervals"),
+                })
+    for module, consts in sorted(found.items()):
+        for name, val in sorted(consts.items()):
+            if name not in expected.get(module, {}):
+                findings.append({
+                    "rule": "key-salt-drift", "where": f"{module}",
+                    "message": f"undeclared salt constant {name}={val} "
+                               f"in {module}: add it to SALT_CONSTANTS "
+                               f"and to a ROOTS interval",
+                })
+    return findings
+
+
+def _match_sites(sites, registry, roots) -> List[Dict[str, Any]]:
+    findings = []
+    hit = [0] * len(registry)
+    for site in sites:
+        matched = False
+        for i, (root, stream, module, key_re, salt_re, _purpose) in enumerate(registry):
+            if (site["module"] == module
+                    and re.fullmatch(key_re, site["key"])
+                    and re.fullmatch(salt_re, site["salt"])):
+                hit[i] += 1
+                matched = True
+        if not matched:
+            findings.append({
+                "rule": "key-undeclared-stream",
+                "where": f"{site['module']}:{site['line']}",
+                "message": (
+                    f"fold_in({site['key']}, {site['salt']}) matches no "
+                    f"SALT_REGISTRY row: declare its (root, stream) "
+                    f"provenance before landing it"),
+            })
+    for i, (root, stream, module, key_re, salt_re, _purpose) in enumerate(registry):
+        declared = {s for s, _lo, _hi in roots.get(root, ())}
+        if root not in roots or stream not in declared:
+            findings.append({
+                "rule": "key-registry-stale",
+                "where": f"registry[{i}] {module}",
+                "message": f"row declares undeclared stream "
+                           f"({root!r}, {stream!r}): add it to ROOTS",
+            })
+        if hit[i] == 0:
+            findings.append({
+                "rule": "key-registry-stale",
+                "where": f"registry[{i}] {module}",
+                "message": (
+                    f"no fold_in site matches ({key_re!r}, {salt_re!r}) "
+                    f"in {module}: the declared '{root}/{stream}' "
+                    f"stream rotted out of the tree"),
+            })
+    return findings
+
+
+def check_binds(bind_files: Sequence[str],
+                registry=SALT_REGISTRY,
+                derived_consumers=None) -> List[Dict[str, Any]]:
+    """Compiled-program cross-check: every source file contributing an
+    in-jaxpr ``random_*``/key-consuming bind must be one the registry
+    models -- or a declared derived-key consumer -- so the bind provably
+    descends from a declared root."""
+    if derived_consumers is None:
+        derived_consumers = DERIVED_CONSUMER_MODULES
+    modeled = {module for _r, _s, module, _k, _sa, _p in registry}
+    modeled |= set(derived_consumers)
+    findings = []
+    for f in sorted(set(bind_files)):
+        if f not in modeled:
+            findings.append({
+                "rule": "key-unrooted-bind",
+                "where": f,
+                "message": (
+                    f"compiled program draws randomness traced to {f}, "
+                    f"which declares no SALT_REGISTRY stream: the bind "
+                    f"has no (salt, purpose) provenance"),
+            })
+    return findings
+
+
+def key_streams_check(package_dir,
+                      registry=SALT_REGISTRY,
+                      roots=ROOTS,
+                      constants=SALT_CONSTANTS,
+                      consumers: frozenset = CONSUMERS,
+                      bind_files: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+    """Run the full key-stream audit over the package tree.
+
+    Returns the ``key_streams`` report section: a summary of the
+    provenance graph plus findings.  All tables are injectable so the
+    regression tests can seed a duplicated salt, an undeclared fold
+    site, or a reused raw key and watch the named finding trip.
+    """
+    package_dir = Path(package_dir)
+    sites = scan_fold_sites(package_dir)
+    found_consts = scan_salt_constants(package_dir)
+
+    findings: List[Dict[str, Any]] = []
+    findings += _check_intervals(roots)
+    findings += _check_constants(found_consts, constants)
+    findings += _match_sites(sites, registry, roots)
+    findings += scan_raw_reuse(package_dir, consumers)
+    if bind_files is not None:
+        findings += check_binds(bind_files, registry)
+
+    streams = {}
+    for root, decl in sorted(roots.items()):
+        streams[root] = [
+            {"stream": s, "lo": lo, "hi": hi} for s, lo, hi in decl]
+    return {
+        "ok": not findings,
+        "fold_in_sites": len(sites),
+        "registry_rows": len(registry),
+        "salt_constants": {m: dict(sorted(c.items()))
+                           for m, c in sorted(found_consts.items())},
+        "roots": streams,
+        "binds_checked": len(set(bind_files)) if bind_files is not None else 0,
+        "findings": findings[:MAX_FINDING_SAMPLES],
+        "findings_total": len(findings),
+    }

@@ -58,6 +58,20 @@ PROGRAM_METRICS: Tuple[Tuple[str, Tuple[str, ...], float, str], ...] = (
 CONFIG_KEYS = ("flagship", "data_name", "model_name", "num_users", "levels",
                "mesh")
 
+#: cross-program coverage counters pinned by the baseline (ISSUE 18):
+#: the declared config lattice and key-stream provenance graph must
+#: never silently SHRINK -- dropping an axis value, a registry row, or
+#: a declared fold_in site without re-pinning is a ratchet regression
+#: (growth is recorded as an improvement).  Finding-grade properties
+#: (unreached points, salt collisions) fail the audit itself and need
+#: no headroom here.
+COVERAGE_METRICS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("lattice.points", ("lattice", "points")),
+    ("lattice.refusal_rules", ("lattice", "refusal_rules")),
+    ("key_streams.fold_in_sites", ("key_streams", "fold_in_sites")),
+    ("key_streams.registry_rows", ("key_streams", "registry_rows")),
+)
+
 
 def _get(d: Optional[Dict[str, Any]], path: Sequence[str]):
     for k in path:
@@ -75,12 +89,17 @@ def baseline_view(report_dict: Dict[str, Any]) -> Dict[str, Any]:
     for name, prog in sorted((report_dict.get("programs") or {}).items()):
         programs[name] = {label: _get(prog, path)
                           for label, path, _tol, _mode in PROGRAM_METRICS}
+    coverage = {}
+    for label, path in COVERAGE_METRICS:
+        v = _get(report_dict, path)
+        coverage[label] = len(v) if isinstance(v, list) else v
     return {
         "version": 2,
         "generated_at": report_dict.get("generated_at"),
         "config": {k: (report_dict.get("config") or {}).get(k)
                    for k in CONFIG_KEYS},
         "programs": programs,
+        "coverage": coverage,
     }
 
 
@@ -115,7 +134,27 @@ def diff_reports(current_dict: Dict[str, Any],
                 "config change is intentional")
         return out
 
-    cur_view = baseline_view(current_dict)["programs"]
+    cur_full = baseline_view(current_dict)
+    base_cov = baseline.get("coverage") or {}
+    for label, _path in COVERAGE_METRICS:
+        base, cur = base_cov.get(label), cur_full["coverage"].get(label)
+        if base is None:
+            continue  # counter not pinned by this baseline
+        if cur is None:
+            regress("<coverage>", label, base, None, 0.0,
+                    "coverage counter recorded in the baseline is absent "
+                    "from the fresh audit (the measurement went dark)")
+        elif cur < base:
+            regress("<coverage>", label, base, cur, 0.0,
+                    "declared coverage shrank below the pinned baseline -- "
+                    "re-pin with --update-baseline if the removal is "
+                    "intentional")
+        elif cur > base:
+            out["improvements"].append(
+                {"program": "<coverage>", "metric": label,
+                 "baseline": base, "current": cur})
+
+    cur_view = cur_full["programs"]
     base_progs = baseline.get("programs") or {}
     for name in sorted(set(base_progs) - set(cur_view)):
         out["ok"] = False

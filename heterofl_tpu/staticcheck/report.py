@@ -89,6 +89,16 @@ class AuditReport:
     #: arms-axis FLOP linearity (ISSUE 14: audit.arms_flop_check) -- an
     #: E-arm program's compiled FLOPs == E x its unbatched twin's
     arms: Dict[str, Any] = field(default_factory=dict)
+    #: config-lattice exhaustiveness (ISSUE 18: lattice.lattice_check) --
+    #: every point of the declared feature lattice classified SUPPORTED
+    #: (audited anchor / equivalence contract) or REFUSED (typed
+    #: ValueError from exactly one resolve_* validator); UNREACHED
+    #: points are findings
+    lattice: Dict[str, Any] = field(default_factory=dict)
+    #: RNG-stream provenance (ISSUE 18: keys.key_streams_check) -- the
+    #: salt/fold_in graph: interval disjointness per root, pinned salt
+    #: constants, declared fold sites, raw-key reuse, jaxpr bind roots
+    key_streams: Dict[str, Any] = field(default_factory=dict)
     lint: List[Finding] = field(default_factory=list)
     #: baseline-ratchet diff (ISSUE 7: staticcheck/ratchet.py).  ``checked``
     #: is False unless the CLI ran ``--diff-baseline``; a regressed ratchet
@@ -118,7 +128,7 @@ class AuditReport:
         for p in self.programs.values():
             out.extend(p.findings)
         for sec in (self.flop_budget, self.recompile, self.wire_frontier,
-                    self.sampler, self.arms):
+                    self.sampler, self.arms, self.lattice, self.key_streams):
             out.extend(Finding(**f) for f in sec.get("findings", []))
         return out
 
@@ -134,6 +144,8 @@ class AuditReport:
             "wire_frontier": self.wire_frontier,
             "sampler": self.sampler,
             "arms": self.arms,
+            "lattice": self.lattice,
+            "key_streams": self.key_streams,
             "ratchet": self.ratchet,
             "lint": [asdict(f) for f in self.lint],
         }

@@ -20,8 +20,10 @@ and anywhere (pre-commit, the CLI's ``--skip-audit`` mode, the test gate).
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
+import tokenize
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -164,15 +166,32 @@ def _collect_pragmas(src: str) -> Tuple[Dict[int, Set[str]],
 
     A pragma covers its own line; a pragma inside a standalone comment
     block also covers the statement line the block precedes (so a
-    multi-line reason can sit above the call it licenses)."""
+    multi-line reason can sit above the call it licenses).
+
+    Only REAL comments count: the source is tokenized and the pragma
+    regex runs on COMMENT tokens, so a pragma-shaped line inside a
+    string literal (e.g. the lint tests' fixture snippets) is neither a
+    licence nor a liveness obligation.  Unparseable source falls back to
+    the line scan -- the lint reports the syntax error separately."""
     lines = src.splitlines()
     out: Dict[int, Set[str]] = {}
     entries: List[PragmaEntry] = []
+
+    comment_lines: Optional[Set[int]] = None
+    try:
+        comment_lines = {
+            tok.start[0]
+            for tok in tokenize.generate_tokens(io.StringIO(src).readline)
+            if tok.type == tokenize.COMMENT}
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        pass
 
     def add(i: int, ids: Set[str]) -> None:
         out.setdefault(i, set()).update(ids)
 
     for i, line in enumerate(lines, start=1):
+        if comment_lines is not None and i not in comment_lines:
+            continue
         m = PRAGMA_RE.search(line)
         if not m:
             continue
@@ -391,3 +410,45 @@ def lint_tree(root: str, rules: Sequence[Rule] = DEFAULT_RULES,
                     # silently skip files (and must keep the rest's findings)
                     findings.append(Finding("unreadable", rel, str(e)))
     return findings + lint_paths(pairs, rules)
+
+
+def pragma_sweep(root: str, rules: Sequence[Rule] = DEFAULT_RULES,
+                 exclude: Sequence[str] = ()) -> List[Finding]:
+    """Whole-repo stale-pragma liveness (ISSUE 18 satellite).
+
+    The banned-call rules stay scoped to the package tree, but pragmas
+    rot ANYWHERE -- a ``# staticcheck: allow(...)`` in tests/ or
+    scripts/ that no longer suppresses anything (or licenses a rule that
+    cannot fire on its path) masks the next real violation just the
+    same.  This walks every ``.py`` under ``root``, runs the full lint
+    per file, and keeps ONLY the pragma-liveness verdicts
+    (``stale-pragma``/``syntax-error``/``unreadable``).  ``exclude``
+    skips top-level subtrees the scoped lint already covered, so the
+    two fronts never double-report."""
+    keep = {"stale-pragma", "syntax-error", "unreadable"}
+    prefix = os.path.basename(os.path.abspath(root))
+    findings: List[Finding] = []
+    skip = set(exclude) | {".git", "__pycache__", ".jax_cache",
+                           ".claude", "node_modules"}
+    for dirpath, dirnames, filenames in os.walk(root):
+        if os.path.abspath(dirpath) == os.path.abspath(root):
+            dirnames[:] = [d for d in dirnames if d not in skip]
+        else:
+            dirnames[:] = [d for d in dirnames
+                           if d not in (".git", "__pycache__", ".jax_cache")]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.join(prefix, os.path.relpath(full, root))
+            try:
+                with open(full, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError as e:
+                findings.append(Finding("unreadable", rel, str(e)))
+                continue
+            if "staticcheck:" not in src:
+                continue  # nothing to audit; skip the parse
+            findings.extend(f for f in lint_source(src, rel, rules)
+                            if f.rule in keep)
+    return findings

@@ -585,11 +585,17 @@ def test_arms_driver_refusals(tmp_path):
     from heterofl_tpu.entry.common import ArmsExperiment
 
     # trace_dir x arms: the multiplexed loop builds no TraceRecorder, so
-    # the trace would be silently empty -- refuse at construction
+    # the trace would be silently empty -- refused at config-resolution
+    # time by resolve_arms_cfg (ISSUE 18: one validator per axis)...
     cfg = _driver_args(tmp_path)
     cfg["arms"] = 2
     cfg["trace_dir"] = str(tmp_path / "tr")
-    cfg = C.process_control(cfg)
+    with pytest.raises(ValueError, match="trace_dir"):
+        C.process_control(cfg)
+    # ...and the driver constructor keeps the same refusal as
+    # defense-in-depth for cfgs that dodged the resolver
+    cfg = C.process_control(_driver_args(tmp_path) | {"arms": 2})
+    cfg["trace_dir"] = str(tmp_path / "tr")
     with pytest.raises(ValueError, match="trace_dir"):
         ArmsExperiment(cfg, 0)
     # an explicit arms mesh axis the device count cannot honor must

@@ -233,8 +233,8 @@ def test_grouped_slice_align_partitions_and_refuses():
 @pytest.mark.slow
 def test_grouped_failure_injection_matches_masked():
     """client_failure_rate: the grouped engine derives the alive set from
-    the same fold_in(key, 98) stream as the masked engine, so with the same
-    key the same clients crash and the aggregates match."""
+    the same failure_stream_key stream as the masked engine, so with the
+    same key the same clients crash and the aggregates match."""
     cfg, ds, data = _vision_setup()
     cfg = dict(cfg, client_failure_rate=0.75)  # P(nobody crashes) ~ 0.4%
     model = make_model(cfg)

@@ -1,0 +1,347 @@
+"""latticecheck (ISSUE 18): config-lattice exhaustiveness + RNG key-stream
+provenance.  Everything here is jax-free by construction -- the lattice
+pass replays the config validator chain and the key-stream pass walks the
+source tree with ast -- so this file never boots a backend.
+
+The seeded-regression tests are the teeth: each finding type the audit
+can emit (unclassified combo, silently-falling-back refusal, rotted
+evidence, duplicated salt, drifted constant, undeclared fold site,
+reused raw key, unrooted bind) is deliberately injected through the
+injectable tables and must trip its named finding."""
+
+import os
+import textwrap
+
+import pytest
+
+from heterofl_tpu import config as C
+from heterofl_tpu.compress import CODEC_NAMES
+from heterofl_tpu.fed.sampling import SAMPLER_KINDS
+from heterofl_tpu.staticcheck import keys as K
+from heterofl_tpu.staticcheck import lattice as L
+
+PKG = os.path.dirname(os.path.dirname(os.path.abspath(L.__file__)))
+REPO = os.path.dirname(PKG)
+
+
+def _defaults():
+    return {axis: vals[0] for axis, vals in L.AXES}
+
+
+def _axes(**overrides):
+    """Shrunken axis table: every axis pinned to its default except the
+    overridden ones -- keeps seeded-regression lattices tiny."""
+    return tuple((a, overrides.get(a, (vals[0],))) for a, vals in L.AXES)
+
+
+# ---------------------------------------------------------------------------
+# the real tree is exhaustively classified and green
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def section():
+    return L.lattice_check()
+
+
+def test_lattice_green_and_exhaustive(section):
+    n = 1
+    for _axis, vals in L.AXES:
+        n *= len(vals)
+    assert section["points"] == n
+    assert section["supported"] + section["refused"] == n
+    assert section["unreached"] == 0
+    assert section["ok"] and section["findings"] == []
+    # both classes are populated: an all-SUPPORTED (or all-REFUSED)
+    # lattice would mean the axis table rotted into triviality
+    assert section["supported"] > 0 and section["refused"] > 0
+
+
+def test_every_declared_refusal_rule_fires(section):
+    assert [r["id"] for r in section["refusal_rules"]] == \
+        [r["id"] for r in L.REFUSAL_RULES]
+    dead = [r["id"] for r in section["refusal_rules"] if r["points"] == 0]
+    assert dead == []
+
+
+def test_every_contract_carries_points(section):
+    # a contract no surviving point uses is dead weight (or a rider rot)
+    dead = [c["name"] for c in section["contracts"] if c["points"] == 0]
+    assert dead == []
+
+
+def test_axes_mirror_config_registries():
+    """The lattice's axis table cannot drift from the live config
+    registries: a value added to one side must show up on the other."""
+    axes = dict(L.AXES)
+    assert axes["engine"] == C.STRATEGIES
+    assert axes["placement"] == C.DATA_PLACEMENTS
+    assert axes["levels"] == C.LEVEL_PLACEMENTS
+    assert axes["store"] == C.CLIENT_STORES
+    assert axes["codec"] == CODEC_NAMES
+    assert set(axes["sampler"]) == set(SAMPLER_KINDS)
+
+
+def test_refusal_owners_exist_in_chain():
+    owners = {name for name, _fn in C.validator_chain()}
+    for rule in L.REFUSAL_RULES:
+        assert rule["owner"] in owners, rule["id"]
+
+
+def test_rule_keys_come_from_axis_cfg_map():
+    declared = {k for keys in L.AXIS_CFG_KEYS.values() for k in keys}
+    for rule in L.REFUSAL_RULES:
+        assert set(rule["keys"]) <= declared, rule["id"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18 satellite: every REFUSED point's ValueError names the
+# offending cfg keys (parametrized over the declared refusal rules)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", L.REFUSAL_RULES, ids=lambda r: r["id"])
+def test_refused_point_message_names_offending_keys(rule):
+    point = _defaults()
+    for axis, want in rule["when"].items():
+        point[axis] = want[0] if isinstance(want, tuple) else want
+    res = L.classify_point(point)
+    assert res["class"] == "REFUSED", point
+    # provenance: SOME declared rule matching this point has the same
+    # owning validator AND every one of its offending cfg keys is named
+    # verbatim in the ValueError message
+    matching = [r for r in L.REFUSAL_RULES
+                if L._rule_matches(r, point) and r["owner"] == res["owner"]]
+    assert matching, (point, res)
+    named = [r for r in matching
+             if all(k in res["message"] for k in r["keys"])]
+    assert named, (res["owner"], res["message"])
+
+
+# ---------------------------------------------------------------------------
+# seeded lattice regressions: each finding type trips by name
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_unclassified_axis_value_trips_unreached():
+    # an axis value nobody declared a refusal rule (or support) for:
+    # resolve_strategy_cfg refuses it, but with no declared provenance
+    axes = _axes(engine=("masked", "quantum"))
+    sec = L.lattice_check(axes=axes, rules=())
+    assert not sec["ok"]
+    assert sec["unreached"] == 1
+    hits = [f for f in sec["findings"] if f["rule"] == "lattice-unreached"]
+    assert hits and "quantum" in hits[0]["where"]
+
+
+def test_seeded_uncovered_combo_trips_unreached():
+    # validators pass but no anchor covers the core -> unclassified combo
+    sec = L.lattice_check(axes=_axes(), anchors={})
+    assert not sec["ok"]
+    assert any(f["rule"] == "lattice-unreached"
+               and "unclassified combo" in f["message"]
+               for f in sec["findings"])
+
+
+def test_seeded_phantom_rule_trips_silent_fallback():
+    # a declared refusal the validators do NOT deliver: the combo would
+    # run and silently degrade -- the exact mid-run-fallback smell the
+    # lattice pass exists to kill
+    phantom = {"id": "phantom-sharded", "when": {"placement": "sharded"},
+               "owner": "resolve_placement_cfg", "keys": ("data_placement",)}
+    sec = L.lattice_check(axes=_axes(placement=("replicated", "sharded")),
+                          rules=(phantom,))
+    assert not sec["ok"]
+    assert any(f["rule"] == "lattice-silent-fallback"
+               for f in sec["findings"])
+
+
+def test_seeded_unknown_owner_trips_silent_fallback():
+    rule = {"id": "ghost", "when": {"engine": "masked"},
+            "owner": "resolve_ghost_cfg", "keys": ("strategy",)}
+    sec = L.lattice_check(axes=_axes(), rules=(rule,))
+    assert any(f["rule"] == "lattice-silent-fallback"
+               and "resolve_ghost_cfg" in f["message"]
+               for f in sec["findings"])
+
+
+def test_seeded_rotted_evidence_trips_evidence_missing():
+    # audited set given but empty: the anchor program backing the
+    # default point is not audited green
+    sec = L.lattice_check(axes=_axes(), rules=(), audited=())
+    assert not sec["ok"]
+    assert sec["evidence_checked"]
+    assert any(f["rule"] == "lattice-evidence-missing"
+               for f in sec["findings"])
+
+
+# ---------------------------------------------------------------------------
+# key streams: the real tree is green
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ks_section():
+    return K.key_streams_check(PKG)
+
+
+def test_key_streams_green_on_real_tree(ks_section):
+    assert ks_section["ok"]
+    assert ks_section["findings_total"] == 0
+    # every declared registry row matched at least one live fold site
+    # (zero-hit rows would be key-registry-stale findings)
+    assert ks_section["fold_in_sites"] >= 50
+    assert ks_section["registry_rows"] == len(K.SALT_REGISTRY)
+
+
+def test_declared_roots_intervals_disjoint(ks_section):
+    # the collision that motivated this pass: ARM_STREAM_SALT=17 sat
+    # inside the host key's per-round epoch family -- prove the fixed
+    # intervals stay disjoint per root
+    assert K._check_intervals(K.ROOTS) == []
+    host = {s["stream"]: (s["lo"], s["hi"])
+            for s in ks_section["roots"]["host_key"]}
+    for stream in ("epoch", "arms", "retry"):
+        assert stream in host
+
+
+# ---------------------------------------------------------------------------
+# seeded key-stream regressions: each finding type trips by name
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_duplicated_salt_trips_collision():
+    roots = dict(K.ROOTS)
+    # an interval landing inside the host key's epoch family -- exactly
+    # the old ARM_STREAM_SALT=17 bug, re-seeded
+    roots["host_key"] = roots["host_key"] + (("evil-dup", 17, 18),)
+    sec = K.key_streams_check(PKG, roots=roots)
+    assert not sec["ok"]
+    assert any(f["rule"] == "key-salt-collision"
+               and "evil-dup" in f["message"]
+               for f in sec["findings"])
+
+
+def test_seeded_salt_drift_trips_by_name():
+    constants = {m: dict(c) for m, c in K.SALT_CONSTANTS.items()}
+    constants["fed/core.py"]["ROUND_RATE_SALT"] = 8
+    sec = K.key_streams_check(PKG, constants=constants)
+    assert not sec["ok"]
+    assert any(f["rule"] == "key-salt-drift"
+               and "ROUND_RATE_SALT" in f["message"]
+               for f in sec["findings"])
+
+
+def test_seeded_undeclared_fold_site(tmp_path):
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        import jax
+
+        def f(key):
+            return jax.random.fold_in(key, 42)
+    """))
+    sec = K.key_streams_check(tmp_path, registry=(), roots={}, constants={})
+    assert not sec["ok"]
+    assert any(f["rule"] == "key-undeclared-stream"
+               for f in sec["findings"])
+
+
+def test_seeded_registry_stale_row(tmp_path):
+    registry = (("ghost_root", "ghost", "no/such/file.py",
+                 r"key", r"42", "a rotted declared stream"),)
+    sec = K.key_streams_check(tmp_path, registry=registry, constants={},
+                              roots={"ghost_root": (("ghost", None, None),)})
+    assert not sec["ok"]
+    assert any(f["rule"] == "key-registry-stale" and "rotted" in f["message"]
+               for f in sec["findings"])
+    # a row naming a (root, stream) absent from ROOTS is the other
+    # stale shape
+    sec = K.key_streams_check(tmp_path, registry=registry, constants={},
+                              roots={})
+    assert any(f["rule"] == "key-registry-stale"
+               and "undeclared stream" in f["message"]
+               for f in sec["findings"])
+
+
+def test_seeded_raw_key_reuse(tmp_path):
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        import jax
+
+        def bad(key):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+
+        def ok_exclusive(key, flag):
+            if flag:
+                return jax.random.normal(key, (4,))
+            else:
+                return jax.random.uniform(key, (4,))
+
+        def ok_rebound(key):
+            for t in range(3):
+                key = jax.random.fold_in(key, t)
+            return jax.random.normal(key, (4,))
+    """))
+    findings = K.scan_raw_reuse(tmp_path)
+    assert [f["rule"] for f in findings] == ["key-raw-reuse"]
+    assert "bad()" in findings[0]["where"]
+    # ...and end-to-end through the section entrypoint
+    sec = K.key_streams_check(tmp_path, registry=(), roots={}, constants={})
+    assert not sec["ok"]
+    assert any(f["rule"] == "key-raw-reuse" for f in sec["findings"])
+
+
+def test_seeded_unrooted_bind():
+    findings = K.check_binds(["heterofl_tpu/nowhere/mystery.py"])
+    assert [f["rule"] for f in findings] == ["key-unrooted-bind"]
+    # files the registry models pass, as do declared derived-key
+    # consumers (ops/quant.py draws on the codec-derived key)
+    assert K.check_binds(["fed/core.py", "parallel/round_engine.py",
+                          "ops/quant.py"]) == []
+    # ...but the consumer declaration is provenance, not a waiver: with
+    # an empty derived map the same bind trips again
+    fs = K.check_binds(["ops/quant.py"], derived_consumers={})
+    assert [f["rule"] for f in fs] == ["key-unrooted-bind"]
+
+
+# ---------------------------------------------------------------------------
+# ratchet wiring: the declared coverage is pinned and cannot shrink
+# ---------------------------------------------------------------------------
+
+
+def test_ratchet_pins_lattice_and_key_coverage():
+    from heterofl_tpu.staticcheck.ratchet import baseline_view, diff_reports
+    rep = {"programs": {}, "config": {},
+           "lattice": {"points": 10, "refusal_rules": [{"id": "a"},
+                                                       {"id": "b"}]},
+           "key_streams": {"fold_in_sites": 5, "registry_rows": 3}}
+    base = baseline_view(rep)
+    assert base["coverage"] == {
+        "lattice.points": 10, "lattice.refusal_rules": 2,
+        "key_streams.fold_in_sites": 5, "key_streams.registry_rows": 3}
+    assert diff_reports(rep, base)["ok"]
+    # shrinkage regresses...
+    shrunk = dict(rep, lattice=dict(rep["lattice"], points=9))
+    d = diff_reports(shrunk, base)
+    assert not d["ok"]
+    assert d["regressions"][0]["metric"] == "lattice.points"
+    # ...growth is an improvement, never a failure
+    grown = dict(rep, key_streams=dict(rep["key_streams"], fold_in_sites=6))
+    d = diff_reports(grown, base)
+    assert d["ok"] and any(i["metric"] == "key_streams.fold_in_sites"
+                           for i in d["improvements"])
+
+
+# ---------------------------------------------------------------------------
+# README's "Compatibility lattice" section is the generated artifact
+# ---------------------------------------------------------------------------
+
+
+def test_readme_lattice_section_in_sync(section):
+    md = L.lattice_markdown(section)
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    assert "## Compatibility lattice" in readme
+    assert md.strip() in readme, (
+        "README's Compatibility-lattice section is stale: regenerate with "
+        "`python -m heterofl_tpu.staticcheck --lattice-md`")

@@ -646,6 +646,11 @@ def test_per_level_map_needs_grouped_engine_and_matching_levels():
                         0.05, np.array([0, 1]), data)
     with pytest.raises(ValueError, match="level table"):
         GroupedRoundEngine(dict(cfg, wire_codec={"1.0": "int8"}), mesh)
+    # the config-RESOLUTION path (driver) still refuses a map under any
+    # other strategy -- the ISSUE 18 promotion lives in resolve_codec_cfg
+    from heterofl_tpu.compress import resolve_codec_cfg
+    with pytest.raises(ValueError, match="strategy='grouped'"):
+        resolve_codec_cfg(dict(cfg, wire_codec=_level_map(cfg)))
 
 
 # ---------------------------------------------------------------------------

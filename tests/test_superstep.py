@@ -361,9 +361,10 @@ def test_driver_superstep_config_conflicts(tmp_path):
         FedExperiment(_driver_cfg(tmp_path, superstep_rounds=4,
                                   eval_interval=2,
                                   scheduler_name="ReduceLROnPlateau"), 0)
-    # still conflicting: Plateau with its metric feed deferred past the
-    # superstep that needs it
-    with pytest.raises(ValueError, match="ReduceLROnPlateau"):
+    # still conflicting: a metric feed deferred past the superstep that
+    # needs it -- refused for ANY scheduler at config resolution now
+    # (ISSUE 18 promotion subsumes the Plateau-specific driver check)
+    with pytest.raises(ValueError, match="metrics_fetch_every"):
         FedExperiment(_driver_cfg(tmp_path, superstep_rounds=2,
                                   eval_interval=2, metrics_fetch_every=4,
                                   scheduler_name="ReduceLROnPlateau"), 0)

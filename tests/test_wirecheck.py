@@ -532,8 +532,9 @@ def test_cli_green_exit_and_json_schema(cli, capsys):
     assert cli("--json") == 0
     rec = json.loads(capsys.readouterr().out)
     assert sorted(rec) == ["arms", "config", "flop_budget", "generated_at",
-                           "lint", "ok", "programs", "ratchet", "recompile",
-                           "sampler", "version", "wire_frontier"]
+                           "key_streams", "lattice", "lint", "ok", "programs",
+                           "ratchet", "recompile", "sampler", "version",
+                           "wire_frontier"]
     prog = rec["programs"]["prog/a"]
     for key in ("wire", "memory", "reshards", "step_body", "psum_clients",
                 "donated", "aliased", "flops", "findings"):
